@@ -1,0 +1,197 @@
+"""Deterministic world checksums as pure integer array ops.
+
+The reference computes, per registered type, a per-entity hash of (stable
+RollbackOrdered index, component hash) XOR-folded across entities, re-hashed to
+break cross-type commutativity, then XORs all parts into a ``Checksum``
+resource (/root/reference/src/snapshot/component_checksum.rs:64-111,
+checksum.rs:86-99).  It uses seahash for portability (snapshot/mod.rs:318-320)
+— the checksum must compare equal across peers.
+
+TPU equivalent: a murmur3-style multiply-rotate-xor mix over the bit pattern
+of each entity row (two independent 32-bit streams -> one 64-bit checksum),
+masked by liveness, XOR-reduced over the entity axis.  Everything is uint32
+arithmetic, which XLA evaluates bit-identically on CPU and TPU — so checksum
+parity across backends holds whenever the underlying state bits match (for
+float simulation math the bits themselves may differ across backends; see
+docs/determinism.md and the reference's own cross-platform warning,
+/root/reference/docs/debugging-desyncs.md:55).
+
+XOR folding is entity-order independent, so sharding the entity axis across
+devices changes nothing (a ``psum``-style XOR all-reduce is exact).  The same
+XOR blind spot the reference documents (checksum.rs:91-93) applies: two equal
+parts cancel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .world import Registry, WorldState, active_mask
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+_SEED_HI = 0x9E3779B9
+_SEED_LO = 0x85EBCA6B
+
+
+def _rotl(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def mix32(h, k):
+    """One murmur3 round: fold lane ``k`` into state ``h`` (uint32 arrays)."""
+    k = k * _C1
+    k = _rotl(k, 15)
+    k = k * _C2
+    h = h ^ k
+    h = _rotl(h, 13)
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def fmix32(h):
+    """murmur3 finalizer — avalanche."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def to_u32_lanes(arr: jnp.ndarray) -> jnp.ndarray:
+    """Bit-cast ``[N, ...]`` -> ``[N, L]`` uint32 lanes (exact, dtype-aware)."""
+    n = arr.shape[0]
+    flat = arr.reshape(n, -1)
+    dt = flat.dtype
+    if dt == jnp.float32:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    if dt in (jnp.int32, jnp.uint32):
+        return flat.astype(jnp.uint32) if dt == jnp.int32 else flat
+    if dt in (jnp.bfloat16, jnp.float16):
+        return jax.lax.bitcast_convert_type(flat, jnp.uint16).astype(jnp.uint32)
+    if dt == jnp.float64:
+        u = jax.lax.bitcast_convert_type(flat, jnp.uint64)
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+        return jnp.concatenate([lo, hi], axis=-1)
+    if dt in (jnp.int64, jnp.uint64):
+        u = flat.astype(jnp.uint64)
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+        return jnp.concatenate([lo, hi], axis=-1)
+    # bool / int8 / uint8 / int16 / uint16: widen exactly
+    return flat.astype(jnp.uint32)
+
+
+def _type_tag(name: str, seed: int) -> jnp.uint32:
+    """Host-side stable tag per registered type name (FNV-1a over utf-8)."""
+    h = 0x811C9DC5 ^ (seed & 0xFFFFFFFF)
+    for b in name.encode():
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return jnp.uint32(h)
+
+
+def _fold_rows(lanes: jnp.ndarray, seed: jnp.uint32) -> jnp.ndarray:
+    """Hash each row of ``[N, L]`` lanes -> uint32[N]."""
+    n, l = lanes.shape
+    h = jnp.full((n,), seed, jnp.uint32)
+    for i in range(l):  # L is static and small
+        h = mix32(h, lanes[:, i])
+    return fmix32(h ^ jnp.uint32(l))
+
+
+def _fold_scalars(values, seed: jnp.uint32) -> jnp.ndarray:
+    h = jnp.asarray(seed, jnp.uint32)
+    for v in values:
+        h = mix32(h, jnp.asarray(v).astype(jnp.uint32))
+    return fmix32(h)
+
+
+def component_part(
+    reg: Registry, w: WorldState, name: str, seed: int
+) -> jnp.ndarray:
+    """Checksum part for one component type (uint32 scalar).
+
+    Per entity: mix(stable id, row bits); masked XOR over entities; re-hash
+    with the type tag — the exact structure of component_checksum.rs:64-108
+    (stable index, custom-or-default hash, XOR, commutativity break)."""
+    spec = reg.components[name]
+    tag = _type_tag(name, seed)
+    col = w.comps[name]
+    if spec.hash_fn is not None:
+        lanes = spec.hash_fn(col)
+        if lanes.ndim == 1:
+            lanes = lanes[:, None]
+        lanes = lanes.astype(jnp.uint32)
+    else:
+        lanes = to_u32_lanes(col)
+    h = _fold_rows(lanes, tag)
+    h = fmix32(mix32(h, w.rollback_id.astype(jnp.uint32)))
+    mask = active_mask(w) & w.has[name]
+    part = jax.lax.reduce(
+        jnp.where(mask, h, jnp.uint32(0)),
+        jnp.uint32(0),
+        jax.lax.bitwise_xor,
+        (0,),
+    )
+    return fmix32(part ^ tag)
+
+
+def resource_part(reg: Registry, w: WorldState, name: str, seed: int) -> jnp.ndarray:
+    """Checksum part for one resource (single hash, no entity loop —
+    resource_checksum.rs:60-84); presence participates in the hash."""
+    spec = reg.resources[name]
+    tag = _type_tag("res:" + name, seed)
+    if spec.hash_fn is not None:
+        lanes = jnp.ravel(spec.hash_fn(w.res[name])).astype(jnp.uint32)
+    else:
+        leaves = jax.tree.leaves(w.res[name])
+        lanes = jnp.concatenate(
+            [to_u32_lanes(jnp.atleast_1d(x)[None]).ravel() for x in leaves]
+        )
+    h = jnp.asarray(tag, jnp.uint32)
+    h = mix32(h, w.res_present[name].astype(jnp.uint32))
+
+    def body(i, h):
+        return mix32(h, lanes[i])
+
+    present_h = jax.lax.fori_loop(0, lanes.shape[0], body, h)
+    h = jnp.where(w.res_present[name], present_h, h)
+    return fmix32(h ^ tag)
+
+
+def entity_part(w: WorldState, seed: int) -> jnp.ndarray:
+    """Hash (active rollback-entity count, total-ever-spawned) — catches
+    spawn/despawn divergence with no registered types
+    (entity_checksum.rs:29-52)."""
+    tag = _type_tag("__entities__", seed)
+    cnt = jnp.sum(active_mask(w)).astype(jnp.uint32)
+    return _fold_scalars([cnt, w.next_id], tag)
+
+
+def world_checksum(reg: Registry, w: WorldState) -> jnp.ndarray:
+    """Full checksum -> uint32[2] (hi, lo) device array.
+
+    XOR of all parts (checksum.rs:88-99) over two independent 32-bit streams;
+    convert with :func:`checksum_to_int` for the cross-peer comparable value."""
+    out = []
+    for seed in (_SEED_HI, _SEED_LO):
+        part = entity_part(w, seed)
+        for name, spec in reg.components.items():
+            if spec.checksum:
+                part = part ^ component_part(reg, w, name, seed)
+        for name, spec in reg.resources.items():
+            if spec.checksum:
+                part = part ^ resource_part(reg, w, name, seed)
+        out.append(part)
+    return jnp.stack(out)
+
+
+def checksum_to_int(cs) -> int:
+    """uint32[2] -> python int (the 64-bit cross-peer checksum value)."""
+    import numpy as np
+
+    a = np.asarray(cs, dtype=np.uint64)
+    return int((a[0] << np.uint64(32)) | a[1])

@@ -1,0 +1,54 @@
+"""Snapshot store/load strategies.
+
+The reference's ``Strategy`` trait (/root/reference/src/snapshot/strategy.rs:22-40)
+is a bijection contract between a live component and its stored form, with
+``CopyStrategy``/``CloneStrategy``/``ReflectStrategy`` implementations.  In JAX
+all values are immutable arrays, so Copy and Clone coincide (the identity) and
+Reflect's dynamic-typing role is played by pytree flattening, which every
+snapshot already gets for free.
+
+The strategy slot stays useful on TPU for a different reason: transforming the
+*stored* representation.  ``QuantizeStrategy`` keeps the ring in bf16/f16,
+halving snapshot HBM footprint — the kind of store/load bijection-with-loss
+tradeoff the trait was designed to express."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Strategy:
+    """Optional store/load transforms applied at snapshot push/restore.
+
+    ``None`` means identity (no work at save/load time)."""
+
+    store: Optional[Callable] = None
+    load: Optional[Callable] = None
+
+
+#: Identity — bitwise snapshot (CopyStrategy, strategy.rs:43-59).
+CopyStrategy = Strategy()
+
+#: Alias: value semantics make copy and clone identical here
+#: (CloneStrategy, strategy.rs:62-83).
+CloneStrategy = Strategy()
+
+#: Alias: pytrees are the reflection layer (ReflectStrategy, strategy.rs:86-110).
+ReflectStrategy = Strategy()
+
+
+def QuantizeStrategy(stored_dtype=jnp.bfloat16) -> Strategy:
+    """Store snapshots in a narrower dtype to cut ring HBM usage.
+
+    Lossy: rolling back through a quantized snapshot re-simulates from the
+    quantized state, which is still deterministic (same snapshot -> same
+    resim) and therefore checksum-safe within a session, but changes values
+    vs. an identity-strategy run.  Use for visual-only state."""
+    return Strategy(
+        store=lambda a: a.astype(stored_dtype),
+        load=lambda a: a,  # re-cast to the live dtype happens in load_state
+    )

@@ -1,0 +1,33 @@
+from .frames import (
+    NULL_FRAME,
+    I32_MIN,
+    I32_MAX,
+    wrap_i32,
+    frame_add,
+    frame_diff,
+    frame_lt,
+    frame_le,
+    frame_gt,
+    frame_ge,
+    frame_max,
+    frame_min,
+)
+from .tracing import span, trace_log, get_trace_events
+
+__all__ = [
+    "NULL_FRAME",
+    "I32_MIN",
+    "I32_MAX",
+    "wrap_i32",
+    "frame_add",
+    "frame_diff",
+    "frame_lt",
+    "frame_le",
+    "frame_gt",
+    "frame_ge",
+    "frame_max",
+    "frame_min",
+    "span",
+    "trace_log",
+    "get_trace_events",
+]

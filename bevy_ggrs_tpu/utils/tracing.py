@@ -1,0 +1,55 @@
+"""Lightweight span tracing for the host-side driver.
+
+The reference wraps request handling and each schedule in tracing spans
+("ggrs"/"HandleRequests", "SaveWorld", "LoadWorld", "AdvanceWorld" —
+/root/reference/src/schedule_systems.rs:171,224-253) and relies on the host
+engine's tracing backend.  Here the equivalent is a process-local ring of
+(name, t_start, t_end) events plus stdlib logging; the JAX profiler covers
+the device side (``jax.profiler.trace``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import deque
+from typing import Deque, Tuple
+
+logger = logging.getLogger("bevy_ggrs_tpu")
+
+_EVENTS: Deque[Tuple[str, float, float]] = deque(maxlen=4096)
+_ENABLED = True
+
+
+def set_tracing(enabled: bool) -> None:
+    global _ENABLED
+    _ENABLED = enabled
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Context manager recording a named wall-clock span."""
+    if not _ENABLED:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        _EVENTS.append((name, t0, t1))
+        logger.debug("span %s: %.3f ms", name, (t1 - t0) * 1e3)
+
+
+def trace_log(msg: str, *args) -> None:
+    logger.debug(msg, *args)
+
+
+def get_trace_events():
+    """Return the recorded (name, t_start, t_end) span events."""
+    return list(_EVENTS)
+
+
+def clear_trace_events() -> None:
+    _EVENTS.clear()

@@ -1,0 +1,15 @@
+"""Test config: force the JAX CPU backend with 8 virtual devices.
+
+Tests run deterministic logic and mesh-sharding paths on a virtual 8-device
+CPU mesh (no TPU needed); the benchmark (bench.py) runs on real hardware.
+Must run before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
