@@ -1,0 +1,56 @@
+"""bevy_ggrs_tpu — a TPU-native rollback-netcode framework.
+
+GGPO-style P2P rollback networking for deterministic simulations, with the
+capability surface of the ``bevy_ggrs`` + ``ggrs`` stack (see SURVEY.md) but
+designed TPU-first: simulation state is columnar SoA arrays on device, a
+rollback of N frames executes as one ``jit(lax.scan(step))`` call, speculative
+remote-input branches fan out under ``vmap``, and checksums are deterministic
+integer array reductions.  The session/network layer (input queues,
+prediction, sync/quality/desync protocol, UDP transport) runs host-side with
+a native C++ core.
+"""
+
+from .app import App, DEFAULT_FPS
+from .runner import GgrsRunner
+from .ops.resim import StepCtx, select_branch, slice_frame
+from .session import (
+    SyncTestSession,
+    InputStatus,
+    SessionState,
+    PlayerType,
+    Player,
+    DesyncDetection,
+    GgrsError,
+    PredictionThresholdError,
+    MismatchedChecksumError,
+    NotSynchronizedError,
+    InvalidRequestError,
+    NetworkStats,
+)
+from .snapshot import (
+    Registry,
+    WorldState,
+    SnapshotRing,
+    MissingSnapshotError,
+    Strategy,
+    CopyStrategy,
+    CloneStrategy,
+    ReflectStrategy,
+    QuantizeStrategy,
+    active_mask,
+    active_count,
+    spawn,
+    spawn_many,
+    despawn,
+    despawn_where,
+    despawn_recursive,
+    insert_component,
+    remove_component,
+    insert_resource,
+    remove_resource,
+    world_checksum,
+    checksum_to_int,
+)
+from .utils.frames import NULL_FRAME
+
+__version__ = "0.1.0"

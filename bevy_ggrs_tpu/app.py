@@ -1,0 +1,158 @@
+"""App — plugin assembly and registration (the ``GgrsPlugin``/``RollbackApp``
+analog, /root/reference/src/lib.rs:200-260 + src/snapshot/rollback_app.rs).
+
+Collects the rollback registry (components, resources, hierarchy, checksums,
+strategies), the user step function (the ``GgrsSchedule`` contents), and the
+simulation constants (players, fps, input spec), then lazily builds the
+compiled device functions (advance / resim / speculate / checksum).
+
+Determinism stance: the step function is a pure JAX function compiled once —
+there is no scheduler to race, which is this framework's stronger version of
+the reference forcing ``AdvanceWorld`` single-threaded and setting schedule
+ambiguity detection to Error (lib.rs:236-246)."""
+
+from __future__ import annotations
+
+from functools import cached_property
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ops.resim import (
+    StepCtx,
+    make_advance_fn,
+    make_resim_fn,
+    make_speculate_fn,
+)
+from .snapshot.checksum import world_checksum
+from .snapshot.strategy import CopyStrategy, Strategy
+from .snapshot.world import Registry, WorldState
+
+DEFAULT_FPS = 60  # /root/reference/src/lib.rs:62
+
+
+class App:
+    def __init__(
+        self,
+        num_players: int = 2,
+        capacity: int = 1024,
+        fps: int = DEFAULT_FPS,
+        input_shape: Tuple[int, ...] = (),
+        input_dtype=np.uint8,
+        seed: int = 0,
+    ):
+        self.num_players = num_players
+        self.fps = fps
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.seed = seed
+        self.reg = Registry(capacity)
+        self._step: Optional[Callable] = None
+        self._setup: Optional[Callable] = None
+
+    # -- registration (RollbackApp surface) --------------------------------
+
+    def rollback_component(
+        self,
+        name: str,
+        shape=(),
+        dtype=jnp.float32,
+        default=None,
+        checksum: bool = False,
+        hash_fn=None,
+        strategy: Strategy = CopyStrategy,
+        required: bool = False,
+    ) -> "App":
+        self.reg.register_component(
+            name, shape, dtype, default, checksum, hash_fn, strategy, required
+        )
+        return self
+
+    def rollback_resource(
+        self,
+        name: str,
+        init,
+        checksum: bool = False,
+        hash_fn=None,
+        present: bool = True,
+        strategy: Strategy = CopyStrategy,
+    ) -> "App":
+        self.reg.register_resource(name, init, checksum, hash_fn, present, strategy)
+        return self
+
+    def checksum_component(self, name: str, hash_fn=None) -> "App":
+        """Enable checksumming for an already-registered component
+        (``checksum_component[_with_hash]``, rollback_app.rs:31-133)."""
+        spec = self.reg.components[name]
+        import dataclasses
+
+        self.reg.components[name] = dataclasses.replace(
+            spec, checksum=True, hash_fn=hash_fn or spec.hash_fn
+        )
+        return self
+
+    def checksum_resource(self, name: str, hash_fn=None) -> "App":
+        spec = self.reg.resources[name]
+        import dataclasses
+
+        self.reg.resources[name] = dataclasses.replace(
+            spec, checksum=True, hash_fn=hash_fn or spec.hash_fn
+        )
+        return self
+
+    def register_hierarchy(self) -> "App":
+        self.reg.register_hierarchy()
+        return self
+
+    def set_step(self, fn: Callable[[WorldState, StepCtx], WorldState]) -> "App":
+        """Set the simulation step (the user's ``GgrsSchedule`` systems)."""
+        self._step = fn
+        self._invalidate()
+        return self
+
+    def set_setup(self, fn: Callable[[WorldState], WorldState]) -> "App":
+        """Optional world-setup function run once at session start."""
+        self._setup = fn
+        return self
+
+    # -- state -------------------------------------------------------------
+
+    def init_state(self) -> WorldState:
+        w = self.reg.init_state()
+        if self._setup is not None:
+            w = self._setup(w)
+        return w
+
+    def zero_inputs(self) -> np.ndarray:
+        return np.zeros((self.num_players, *self.input_shape), self.input_dtype)
+
+    # -- compiled functions (lazy) ------------------------------------------
+
+    @property
+    def step(self):
+        if self._step is None:
+            raise RuntimeError("App.set_step was never called")
+        return self._step
+
+    def _invalidate(self):
+        for k in ("advance_fn", "resim_fn", "speculate_fn", "checksum_fn"):
+            self.__dict__.pop(k, None)
+
+    @cached_property
+    def advance_fn(self):
+        return make_advance_fn(self.reg, self.step, self.fps, self.seed)
+
+    @cached_property
+    def resim_fn(self):
+        return make_resim_fn(self.reg, self.step, self.fps, self.seed)
+
+    @cached_property
+    def speculate_fn(self):
+        return make_speculate_fn(self.reg, self.step, self.fps, self.seed)
+
+    @cached_property
+    def checksum_fn(self):
+        import jax
+
+        return jax.jit(lambda w: world_checksum(self.reg, w))

@@ -1,0 +1,3 @@
+from . import box_game
+
+__all__ = ["box_game"]

@@ -1,0 +1,116 @@
+"""box_game — the canonical 2-4 player example model.
+
+Behavioral port of the reference's shared box_game logic
+(/root/reference/examples/box_game/box_game.rs): each player is a cube on an
+ice rink driven by a 4-bit direction bitmask input (``BoxInput(u8)``,
+box_game.rs:34-38); acceleration from input, friction decay, positions
+clamped to the rink.  Re-expressed as a pure vectorized step over SoA columns
+— per-player independence is what made the reference's unsorted query
+iteration safe (box_game.rs:162-169); here it is a plain masked array op.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..app import App
+from ..ops.resim import StepCtx
+from ..snapshot.world import WorldState, active_mask, spawn
+
+INPUT_UP = 1 << 0
+INPUT_DOWN = 1 << 1
+INPUT_LEFT = 1 << 2
+INPUT_RIGHT = 1 << 3
+
+MOVEMENT_SPEED = jnp.float32(0.005)
+MAX_SPEED = jnp.float32(0.05)
+FRICTION = jnp.float32(0.9975)
+ARENA_HALF = jnp.float32(4.0)
+
+
+def step(world: WorldState, ctx: StepCtx) -> WorldState:
+    handle = world.comps["handle"].astype(jnp.int32)
+    mask = active_mask(world) & world.has["handle"]
+    # gather this entity's input byte by player handle
+    inp = ctx.inputs.reshape(-1)[jnp.clip(handle, 0, ctx.inputs.shape[0] - 1)]
+    inp = jnp.where(mask, inp, 0).astype(jnp.uint8)
+
+    def bit(b):
+        return ((inp >> b) & 1).astype(jnp.float32)
+
+    acc_x = (bit(3) - bit(2)) * MOVEMENT_SPEED  # right - left
+    acc_z = (bit(1) - bit(0)) * MOVEMENT_SPEED  # down - up
+
+    vel = world.comps["vel"]
+    vel = vel + jnp.stack([acc_x, acc_z], axis=-1)
+    vel = vel * FRICTION
+    speed = jnp.sqrt(jnp.sum(vel * vel, axis=-1, keepdims=True))
+    scale = jnp.where(speed > MAX_SPEED, MAX_SPEED / jnp.maximum(speed, 1e-9), 1.0)
+    vel = vel * scale
+
+    pos = world.comps["pos"] + vel
+    pos = jnp.clip(pos, -ARENA_HALF, ARENA_HALF)
+
+    m = mask[:, None]
+    import dataclasses
+
+    return dataclasses.replace(
+        world,
+        comps={
+            **world.comps,
+            "vel": jnp.where(m, vel, world.comps["vel"]),
+            "pos": jnp.where(m, pos, world.comps["pos"]),
+        },
+    )
+
+
+def setup(app: App):
+    """Spawn one cube per player at spread-out rink positions
+    (box_game.rs spawn pattern: players on a circle)."""
+
+    def fn(world: WorldState) -> WorldState:
+        n = app.num_players
+        for h in range(n):
+            angle = 2.0 * np.pi * h / n
+            pos = jnp.array(
+                [np.cos(angle) * 2.0, np.sin(angle) * 2.0], jnp.float32
+            )
+            world, _ = spawn(
+                app.reg,
+                world,
+                {"pos": pos, "vel": jnp.zeros(2, jnp.float32), "handle": h},
+            )
+        return world
+
+    return fn
+
+
+def make_app(num_players: int = 2, capacity: int = 8, fps: int = 60) -> App:
+    app = App(
+        num_players=num_players,
+        capacity=capacity,
+        fps=fps,
+        input_shape=(),
+        input_dtype=np.uint8,
+    )
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("vel", (2,), jnp.float32, checksum=True)
+    app.rollback_component("handle", (), jnp.int32, checksum=True)
+    app.set_step(step)
+    app.set_setup(setup(app))
+    return app
+
+
+def keys_to_input(up=False, down=False, left=False, right=False) -> np.uint8:
+    """Keyboard -> BoxInput bitmask (box_game.rs:60-87 read_local_inputs)."""
+    v = 0
+    if up:
+        v |= INPUT_UP
+    if down:
+        v |= INPUT_DOWN
+    if left:
+        v |= INPUT_LEFT
+    if right:
+        v |= INPUT_RIGHT
+    return np.uint8(v)

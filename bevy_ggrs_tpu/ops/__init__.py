@@ -1,0 +1,21 @@
+from .resim import (
+    StepCtx,
+    advance,
+    resim,
+    make_advance_fn,
+    make_resim_fn,
+    make_speculate_fn,
+    select_branch,
+    slice_frame,
+)
+
+__all__ = [
+    "StepCtx",
+    "advance",
+    "resim",
+    "make_advance_fn",
+    "make_resim_fn",
+    "make_speculate_fn",
+    "select_branch",
+    "slice_frame",
+]

@@ -1,0 +1,170 @@
+"""Advance / resimulate / speculate — the device-side frame engine.
+
+The reference's request loop runs, per rollback, one LoadWorld then N×
+(AdvanceWorld + SaveWorld) as separate host-ECS schedule executions
+(/root/reference/src/schedule_systems.rs:189-270; docs/architecture.md:21).
+Here that whole batch is ONE compiled call: ``lax.scan`` over the N frames,
+emitting every intermediate state (the saves) and checksum as stacked outputs,
+so a deep rollback costs one device dispatch instead of N schedule runs.
+
+Speculation goes further than the reference can: ``vmap`` over M predicted
+remote-input branches evaluates M alternative futures in parallel; when the
+real input arrives, picking the matching branch replaces an entire rollback
+resim with a select (the north-star `jit(vmap(lax.scan(step)))` shape).
+
+Frame semantics match the reference: an AdvanceFrame request increments the
+frame counter *then* runs the step (schedule_systems.rs:251-268), so the step
+computing frame ``f`` sees ``ctx.frame == f`` and GgrsTime ``f / fps``
+(src/time.rs:63-87); confirmed-despawn sweeps run at the head of every advance
+(src/snapshot/set.rs:69-82).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..snapshot.world import Registry, WorldState, despawn_confirmed
+from ..snapshot.checksum import world_checksum
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StepCtx:
+    """Per-frame context handed to the user step function.
+
+    ``inputs``/``input_status`` are the ``PlayerInputs`` analog
+    (/root/reference/src/lib.rs:92-98); ``time_seconds`` is ``Time<GgrsTime>``
+    (frame / fps, src/time.rs:63-87); ``rng_key`` is a per-frame-derived PRNG
+    key for convenience (fold of a session seed and the frame — deterministic
+    across peers; stateful RNG can instead live in a rollback resource like the
+    particles example's Xoshiro, /root/reference/examples/stress_tests/
+    particles.rs:125-128)."""
+
+    inputs: jnp.ndarray  # [num_players, *input_shape]
+    input_status: jnp.ndarray  # int8[num_players] (InputStatus)
+    frame: jnp.ndarray  # int32 scalar — the frame being computed
+    confirmed: jnp.ndarray  # int32 scalar — last confirmed frame
+    time_seconds: jnp.ndarray  # f32 scalar — GgrsTime total
+    delta_seconds: jnp.ndarray  # f32 scalar — 1 / fps
+    rng_key: jnp.ndarray  # jax PRNG key data
+
+
+StepFn = Callable[[WorldState, StepCtx], WorldState]
+
+
+def _make_ctx(inputs, status, frame, confirmed, fps: int, seed: int) -> StepCtx:
+    frame = jnp.asarray(frame, jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), frame.astype(jnp.uint32))
+    return StepCtx(
+        inputs=inputs,
+        input_status=status,
+        frame=frame,
+        confirmed=jnp.asarray(confirmed, jnp.int32),
+        time_seconds=frame.astype(jnp.float32) / fps,
+        delta_seconds=jnp.float32(1.0 / fps),
+        rng_key=key,
+    )
+
+
+def advance(
+    reg: Registry,
+    step_fn: StepFn,
+    state: WorldState,
+    inputs,
+    status,
+    frame,
+    confirmed,
+    fps: int,
+    seed: int = 0,
+) -> WorldState:
+    """One AdvanceWorld: confirmed-despawn sweep, then the user step."""
+    state = despawn_confirmed(reg, state, confirmed)
+    ctx = _make_ctx(inputs, status, frame, confirmed, fps, seed)
+    return step_fn(state, ctx)
+
+
+def resim(
+    reg: Registry,
+    step_fn: StepFn,
+    state: WorldState,
+    inputs_seq,  # [k, num_players, *input_shape]
+    status_seq,  # int8[k, num_players]
+    start_frame,  # int32: frame the state currently sits at
+    confirmed,
+    fps: int,
+    seed: int = 0,
+) -> Tuple[WorldState, WorldState, jnp.ndarray]:
+    """Advance ``k`` frames in one fused scan.
+
+    Returns ``(final_state, stacked_states, checksums)`` where
+    ``stacked_states`` holds the state *after* each advance (leading axis k —
+    the per-frame SaveWorld outputs) and ``checksums`` is uint32[k, 2]."""
+    start_frame = jnp.asarray(start_frame, jnp.int32)
+
+    def body(carry, x):
+        st, f = carry
+        inp, stat = x
+        nf = f + 1  # AdvanceFrame increments, then steps
+        st = advance(reg, step_fn, st, inp, stat, nf, confirmed, fps, seed)
+        return (st, nf), (st, world_checksum(reg, st))
+
+    (final, _), (stacked, checks) = jax.lax.scan(
+        body, (state, start_frame), (inputs_seq, status_seq)
+    )
+    return final, stacked, checks
+
+
+def make_advance_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
+    """jit-compiled single-frame advance returning (state, checksum)."""
+
+    @jax.jit
+    def fn(state, inputs, status, frame, confirmed):
+        st = advance(reg, step_fn, state, inputs, status, frame, confirmed, fps, seed)
+        return st, world_checksum(reg, st)
+
+    return fn
+
+
+def make_resim_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
+    """jit-compiled k-frame resim (one compile per distinct k)."""
+
+    @jax.jit
+    def fn(state, inputs_seq, status_seq, start_frame, confirmed):
+        return resim(
+            reg, step_fn, state, inputs_seq, status_seq, start_frame, confirmed, fps, seed
+        )
+
+    return fn
+
+
+def make_speculate_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
+    """jit(vmap(scan)) — evaluate M speculative input branches in parallel.
+
+    ``inputs_branches``: [M, k, P, *input_shape]; state is broadcast.  Returns
+    (final_states[M], stacked[M, k], checksums[M, k, 2]).  Select the branch
+    matching the arrived real inputs with :func:`select_branch`."""
+
+    @jax.jit
+    def fn(state, inputs_branches, status_branches, start_frame, confirmed):
+        return jax.vmap(
+            lambda inp, stat: resim(
+                reg, step_fn, state, inp, stat, start_frame, confirmed, fps, seed
+            )
+        )(inputs_branches, status_branches)
+
+    return fn
+
+
+def select_branch(tree, idx):
+    """Pick branch ``idx`` from a leading-axis-M speculation output."""
+    return jax.tree.map(lambda a: a[idx], tree)
+
+
+def slice_frame(stacked_states, i):
+    """Extract the state after the (i+1)-th advance from stacked resim output."""
+    return jax.tree.map(lambda a: a[i], stacked_states)

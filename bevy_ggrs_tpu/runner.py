@@ -1,0 +1,240 @@
+"""GgrsRunner — the schedule driver (``run_ggrs_schedules`` analog,
+/root/reference/src/schedule_systems.rs:19-289).
+
+Owns the fixed-timestep accumulator (ns-precision period, run-slow x11/10 —
+schedule_systems.rs:31-38), polls remote clients every host tick, steps the
+session, and dispatches its request stream to the device.
+
+The key TPU-first move is in :meth:`_handle_requests`: the reference executes
+every request as a separate host-ECS schedule run (:189-270); here a maximal
+``[Load?] (Advance|Save)*`` run is fused into ONE compiled ``lax.scan`` call
+that returns all intermediate states and checksums — a rollback of depth N is
+one device dispatch.  Checksums are handed to the session as lazy providers so
+device->host syncs only happen when the protocol needs the value."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .app import App
+from .session.events import (
+    InputStatus,
+    MismatchedChecksumError,
+    PredictionThresholdError,
+    SessionState,
+)
+from .session.requests import AdvanceRequest, GgrsRequest, LoadRequest, SaveRequest
+from .session.synctest import SyncTestSession
+from .snapshot.checksum import checksum_to_int
+from .snapshot.ring import SnapshotRing
+from .ops.resim import slice_frame
+from .utils.frames import NULL_FRAME
+from .utils.tracing import span, trace_log
+
+
+class GgrsRunner:
+    def __init__(
+        self,
+        app: App,
+        session=None,
+        read_inputs: Optional[Callable[[List[int]], Dict[int, np.ndarray]]] = None,
+        on_event: Optional[Callable] = None,
+        on_mismatch: Optional[Callable[[MismatchedChecksumError], None]] = None,
+        initial_state=None,
+    ):
+        self.app = app
+        self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
+        self.on_event = on_event
+        self.on_mismatch = on_mismatch
+        self.world = initial_state if initial_state is not None else app.init_state()
+        self._world_checksum = app.checksum_fn(self.world)
+        self.ring: SnapshotRing = SnapshotRing(depth=8)
+        self.frame = 0  # RollbackFrameCount
+        self.confirmed = NULL_FRAME  # ConfirmedFrameCount
+        self.accumulator = 0.0
+        self.run_slow = False
+        self.local_players: List[int] = []
+        self.events: List = []
+        self.session = None
+        self.stalled_frames = 0  # PredictionThreshold skips (observability)
+        if session is not None:
+            self.set_session(session)
+
+    # -- session lifecycle (restart semantics, schedule_systems.rs:70-79) ---
+
+    def set_session(self, session) -> None:
+        """Insert (or replace) the session; None resets driver state the way
+        removing the ``Session`` resource does in the reference."""
+        self.session = session
+        self.accumulator = 0.0
+        self.run_slow = False
+        self.local_players = []
+        self.frame = 0
+        self.confirmed = NULL_FRAME
+        self.ring.clear()
+        if session is not None:
+            self.ring.set_depth(session.max_prediction() + 2)
+
+    # -- fixed-timestep driver (schedule_systems.rs:19-83) ------------------
+
+    def update(self, delta_seconds: float) -> None:
+        """One host tick: accumulate time, poll the network, run 0+ GGRS frames."""
+        fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
+        self.accumulator += delta_seconds
+        if self.session is None:
+            self.accumulator = 0.0
+            return
+        if hasattr(self.session, "poll_remote_clients"):
+            self.session.poll_remote_clients()
+            self._drain_events()
+        while self.accumulator >= fps_delta:
+            self.accumulator -= fps_delta
+            if hasattr(self.session, "frames_ahead"):
+                self.run_slow = self.session.frames_ahead() > 0
+            self._step_session()
+            fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
+
+    def tick(self) -> None:
+        """Run exactly one GGRS frame (manual-clock test pattern — the
+        TimeUpdateStrategy::ManualDuration analog, tests/common/mod.rs:45-55)."""
+        self.update(1.0 / self.app.fps)
+
+    # -- per-session-type steps ---------------------------------------------
+
+    def _step_session(self) -> None:
+        s = self.session
+        if isinstance(s, SyncTestSession):
+            self._step_synctest()
+        elif getattr(s, "is_spectator", False):
+            self._step_spectator()
+        else:
+            self._step_p2p()
+
+    def _step_synctest(self) -> None:
+        s = self.session
+        self.local_players = list(range(s.num_players()))
+        for handle, value in self.read_inputs(self.local_players).items():
+            s.add_local_input(handle, value)
+        try:
+            requests = s.advance_frame()
+        except MismatchedChecksumError as e:
+            trace_log("SyncTest mismatch: %s", e)
+            if self.on_mismatch is not None:
+                self.on_mismatch(e)
+            return
+        self._handle_requests(requests)
+
+    def _step_p2p(self) -> None:
+        s = self.session
+        self.local_players = list(s.local_player_handles())
+        if s.current_state() == SessionState.RUNNING:
+            for handle, value in self.read_inputs(self.local_players).items():
+                s.add_local_input(handle, value)
+        try:
+            requests = s.advance_frame()
+        except PredictionThresholdError:
+            trace_log("frame %d skipped: prediction threshold", self.frame)
+            self.stalled_frames += 1
+            return
+        self._drain_events()
+        self._handle_requests(requests)
+
+    def _step_spectator(self) -> None:
+        s = self.session
+        self.local_players = []
+        if s.current_state() != SessionState.RUNNING:
+            return
+        try:
+            requests = s.advance_frame()
+        except PredictionThresholdError:
+            trace_log("spectator frame skipped: waiting for host input")
+            self.stalled_frames += 1
+            return
+        self._handle_requests(requests)
+
+    def _drain_events(self) -> None:
+        s = self.session
+        if hasattr(s, "events"):
+            for ev in s.events():
+                self.events.append(ev)
+                if self.on_event is not None:
+                    self.on_event(ev)
+
+    # -- request dispatch (the TPU-offload seam, SURVEY §3.6) ---------------
+
+    def _handle_requests(self, requests: List[GgrsRequest]) -> None:
+        with span("HandleRequests"):
+            s = self.session
+            # mirror session -> driver counters (schedule_systems.rs:195-220)
+            self.ring.set_depth(s.max_prediction() + 2)
+            self.confirmed = s.confirmed_frame()
+            self.ring.confirm(self.confirmed)  # discard_old_snapshots
+            i = 0
+            n = len(requests)
+            while i < n:
+                r = requests[i]
+                if isinstance(r, LoadRequest):
+                    self._load(r.frame)
+                    i += 1
+                else:
+                    j = i
+                    while j < n and isinstance(
+                        requests[j], (AdvanceRequest, SaveRequest)
+                    ):
+                        j += 1
+                    self._run_batch(requests[i:j])
+                    i = j
+
+    def _load(self, frame: int) -> None:
+        """LoadGameState: restore the ring snapshot for ``frame``
+        (schedule_systems.rs:238-249)."""
+        with span("LoadWorld"):
+            stored, checksum = self.ring.rollback(frame)
+            self.world = self.app.reg.load_state(stored)
+            self._world_checksum = checksum
+            self.frame = frame
+
+    def _run_batch(self, run: List[GgrsRequest]) -> None:
+        """Execute a maximal Advance/Save run as one fused device call."""
+        adv = [r for r in run if isinstance(r, AdvanceRequest)]
+        k = len(adv)
+        identity = self.app.reg.is_identity_strategy()
+        pre_world, pre_checksum = self.world, self._world_checksum
+        stacked = checks = None
+        if k > 0:
+            with span("AdvanceWorld"):
+                inputs = np.stack([a.inputs for a in adv])
+                status = np.stack([a.status for a in adv])
+                final, stacked, checks = self.app.resim_fn(
+                    self.world, inputs, status, self.frame, self.confirmed
+                )
+                self.world = final
+                self._world_checksum = checks[k - 1]
+                self.frame += k
+        with span("SaveWorld"):
+            c = 0  # advances seen so far within the run
+            for r in run:
+                if isinstance(r, AdvanceRequest):
+                    c += 1
+                    continue
+                if c == 0:
+                    state_s, cs = pre_world, pre_checksum
+                else:
+                    state_s = slice_frame(stacked, c - 1)
+                    cs = checks[c - 1]
+                stored = state_s if identity else self.app.reg.store_state(state_s)
+                self.ring.push(r.frame, (stored, cs))
+                r.cell.save(r.frame, _provider(cs))
+
+
+def _provider(cs):
+    forced = []
+
+    def get() -> int:
+        if not forced:
+            forced.append(checksum_to_int(cs))
+        return forced[0]
+
+    return get

@@ -1,0 +1,143 @@
+"""Session-facing enums, events, and errors — the GGRS surface the driver and
+user code consume (reconstructed API per SURVEY.md §2.3; citations inline)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+
+class InputStatus(enum.IntEnum):
+    """Per-player input status delivered with PlayerInputs
+    (/root/reference/src/lib.rs:92-94)."""
+
+    CONFIRMED = 0
+    PREDICTED = 1
+    DISCONNECTED = 2
+
+
+class SessionState(enum.Enum):
+    """P2P/Spectator lifecycle (`current_state()`,
+    /root/reference/src/schedule_systems.rs:140)."""
+
+    SYNCHRONIZING = "synchronizing"
+    RUNNING = "running"
+
+
+class PlayerType(enum.Enum):
+    LOCAL = "local"
+    REMOTE = "remote"
+    SPECTATOR = "spectator"
+
+
+@dataclass(frozen=True)
+class Player:
+    kind: PlayerType
+    handle: int
+    address: Optional[Any] = None  # remote/spectator peer address
+
+
+class DesyncDetection:
+    """Desync-detection mode (`with_desync_detection_mode`, SURVEY §2.3)."""
+
+    def __init__(self, interval: Optional[int] = None):
+        self.interval = interval  # None = Off; n = compare every n frames
+
+    OFF: "DesyncDetection"
+
+    @staticmethod
+    def on(interval: int) -> "DesyncDetection":
+        return DesyncDetection(interval)
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval is not None
+
+
+DesyncDetection.OFF = DesyncDetection(None)
+
+
+# -- events (GgrsEvent<T>, consumed via session.events();
+#    /root/reference/examples/box_game/box_game_p2p.rs:104-119) --------------
+
+
+@dataclass(frozen=True)
+class Synchronizing:
+    addr: Any
+    total: int
+    count: int
+
+
+@dataclass(frozen=True)
+class Synchronized:
+    addr: Any
+
+
+@dataclass(frozen=True)
+class Disconnected:
+    addr: Any
+
+
+@dataclass(frozen=True)
+class NetworkInterrupted:
+    addr: Any
+    disconnect_timeout_ms: int
+
+
+@dataclass(frozen=True)
+class NetworkResumed:
+    addr: Any
+
+
+@dataclass(frozen=True)
+class DesyncDetected:
+    frame: int
+    local_checksum: int
+    remote_checksum: int
+    addr: Any
+
+
+# -- errors (GgrsError) ------------------------------------------------------
+
+
+class GgrsError(Exception):
+    pass
+
+
+class PredictionThresholdError(GgrsError):
+    """Too far ahead of remote inputs — the driver logs and skips the frame
+    (/root/reference/src/schedule_systems.rs:162-164)."""
+
+
+class MismatchedChecksumError(GgrsError):
+    """SyncTest resimulation produced a different checksum
+    (/root/reference/src/schedule_systems.rs:106-115)."""
+
+    def __init__(self, current_frame: int, mismatched_frames: List[int]):
+        self.current_frame = current_frame
+        self.mismatched_frames = mismatched_frames
+        super().__init__(
+            f"checksum mismatch at frames {mismatched_frames} "
+            f"(current frame {current_frame})"
+        )
+
+
+class NotSynchronizedError(GgrsError):
+    """Session is still synchronizing with remotes."""
+
+
+class InvalidRequestError(GgrsError):
+    """Misuse of the session API (bad handle, missing input, ...)."""
+
+
+@dataclass
+class NetworkStats:
+    """`network_stats(handle)` surface
+    (/root/reference/examples/box_game/box_game_p2p.rs:121-142)."""
+
+    ping_ms: float = 0.0
+    send_queue_len: int = 0
+    kbps_sent: float = 0.0
+    local_frames_behind: int = 0
+    remote_frames_behind: int = 0

@@ -1,0 +1,50 @@
+"""GgrsRequest stream — the contract between sessions and the driver.
+
+Mirrors ``GgrsRequest::{SaveGameState, LoadGameState, AdvanceFrame}``
+(/root/reference/src/schedule_systems.rs:222-269).  Like the reference, the
+save cell carries only the *checksum* — real state lives in the driver's
+snapshot ring, not in the session (schedule_systems.rs:236: the plugin calls
+``cell.save(frame, None, checksum)``).  The checksum is passed as a lazy
+provider so a device->host sync only happens when the protocol actually needs
+the value (SyncTest comparison, desync-detection interval frames)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+
+class SaveCell:
+    """Session-owned storage for one saved frame's checksum."""
+
+    def __init__(self, session, frame: int):
+        self._session = session
+        self.frame = frame
+
+    def save(self, frame: int, checksum_provider: Optional[Callable[[], int]]):
+        """Record the checksum provider for this frame (state stays driver-side)."""
+        self._session._on_cell_saved(frame, checksum_provider)
+
+
+@dataclass
+class SaveRequest:
+    frame: int
+    cell: SaveCell
+
+
+@dataclass
+class LoadRequest:
+    frame: int
+
+
+@dataclass
+class AdvanceRequest:
+    """Inputs for one frame: [num_players, ...] array + per-player status."""
+
+    inputs: np.ndarray
+    status: np.ndarray  # int8[num_players] of InputStatus values
+
+
+GgrsRequest = Union[SaveRequest, LoadRequest, AdvanceRequest]

@@ -1,0 +1,135 @@
+"""SyncTestSession — the determinism oracle.
+
+Semantics per SURVEY §2.3/§3.5 (reconstructed from
+/root/reference/src/schedule_systems.rs:85-118,199-209 and
+tests/common/mod.rs): every ``advance_frame`` the session emits requests that
+(1) save and advance the live frame, then (2) roll back ``check_distance``
+frames and re-simulate to the present, saving each frame again.  Each frame
+thus gets checksummed once live and ~check_distance more times from
+progressively older snapshots; any disagreement raises
+:class:`MismatchedChecksumError` on the next ``advance_frame`` (the driver
+surfaces it as a SyncTestMismatch event).  Confirmed frame =
+``current - check_distance`` (schedule_systems.rs:206-209).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.frames import NULL_FRAME
+from .events import InputStatus, InvalidRequestError, MismatchedChecksumError
+from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
+
+
+class SyncTestSession:
+    def __init__(
+        self,
+        num_players: int,
+        input_shape=(),
+        input_dtype=np.uint8,
+        check_distance: int = 2,
+        input_delay: int = 0,
+        max_prediction: int = 8,
+    ):
+        self._num_players = num_players
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.check_distance = int(check_distance)
+        self.input_delay = int(input_delay)
+        self._max_prediction = max(max_prediction, check_distance + 1)
+        self.current_frame = 0
+        # frame -> [P, *shape] effective (post-delay) confirmed inputs
+        self._inputs: Dict[int, np.ndarray] = {}
+        self._staged: Dict[int, np.ndarray] = {}
+        # frame -> list of (checksum provider | forced int)
+        self._cells: Dict[int, List] = {}
+
+    # -- GGRS session surface ---------------------------------------------
+
+    def num_players(self) -> int:
+        return self._num_players
+
+    def max_prediction(self) -> int:
+        return self._max_prediction
+
+    def confirmed_frame(self) -> int:
+        if self.check_distance == 0:
+            return self.current_frame
+        return max(self.current_frame - self.check_distance, NULL_FRAME)
+
+    def add_local_input(self, handle: int, value) -> None:
+        if not (0 <= handle < self._num_players):
+            raise InvalidRequestError(f"invalid player handle {handle}")
+        arr = np.asarray(value, self.input_dtype).reshape(self.input_shape)
+        self._staged[handle] = arr
+
+    def advance_frame(self) -> List:
+        if len(self._staged) != self._num_players:
+            missing = set(range(self._num_players)) - set(self._staged)
+            raise InvalidRequestError(f"missing local input for players {missing}")
+
+        self._check_mismatches()
+
+        # apply input delay: input staged now takes effect at frame+delay;
+        # frames before the first delayed input see the default (zero) input
+        eff_frame = self.current_frame + self.input_delay
+        packed = np.stack(
+            [self._staged[h] for h in range(self._num_players)]
+        ).astype(self.input_dtype)
+        self._inputs[eff_frame] = packed
+        self._staged.clear()
+
+        f = self.current_frame
+        status = np.full((self._num_players,), InputStatus.CONFIRMED, np.int8)
+        requests: List = [
+            SaveRequest(f, SaveCell(self, f)),
+            AdvanceRequest(self._input_for(f), status),
+        ]
+        d = self.check_distance
+        if d > 0 and f + 1 >= d:
+            t = f + 1 - d
+            requests.append(LoadRequest(t))
+            for i in range(t, f + 1):
+                requests.append(AdvanceRequest(self._input_for(i), status))
+                requests.append(SaveRequest(i + 1, SaveCell(self, i + 1)))
+        self.current_frame = f + 1
+        self._gc()
+        return requests
+
+    # -- internals ---------------------------------------------------------
+
+    def _input_for(self, frame: int) -> np.ndarray:
+        default = np.zeros((self._num_players, *self.input_shape), self.input_dtype)
+        return self._inputs.get(frame, default)
+
+    def _on_cell_saved(self, frame: int, provider) -> None:
+        self._cells.setdefault(frame, []).append(provider)
+
+    def _check_mismatches(self) -> None:
+        mismatched = []
+        for frame, entries in self._cells.items():
+            if len(entries) < 2:
+                continue
+            vals = set()
+            for i, e in enumerate(entries):
+                v = e() if callable(e) else e
+                entries[i] = v  # memoize forced value
+                if v is not None:
+                    vals.add(v)
+            if len(vals) > 1:
+                mismatched.append(frame)
+        if mismatched:
+            frames = sorted(mismatched)
+            for fr in frames:
+                del self._cells[fr]
+            raise MismatchedChecksumError(self.current_frame, frames)
+
+    def _gc(self) -> None:
+        # a frame can still receive saves until current passes it by d+1
+        horizon = self.current_frame - self.check_distance - 2
+        for fr in [fr for fr in self._cells if fr < horizon]:
+            del self._cells[fr]
+        for fr in [fr for fr in self._inputs if fr < horizon]:
+            del self._inputs[fr]
