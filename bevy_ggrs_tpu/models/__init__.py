@@ -1,3 +1,3 @@
-from . import box_game
+from . import box_game, particles
 
-__all__ = ["box_game"]
+__all__ = ["box_game", "particles"]

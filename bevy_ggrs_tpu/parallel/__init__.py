@@ -1,0 +1,19 @@
+from .mesh import (
+    DATA_AXIS,
+    SPEC_AXIS,
+    make_mesh,
+    world_sharding,
+    shard_world,
+    make_sharded_resim_fn,
+    make_sharded_speculate_fn,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "SPEC_AXIS",
+    "make_mesh",
+    "world_sharding",
+    "shard_world",
+    "make_sharded_resim_fn",
+    "make_sharded_speculate_fn",
+]

@@ -1,0 +1,110 @@
+"""Device-mesh sharding of the rollback world — scale past one chip.
+
+The reference is a single-process library; its scaling axes are entity count
+and rollback depth.  Here the entity (capacity) axis shards across a
+``jax.sharding.Mesh`` "data" axis, and speculative input branches shard
+across a "spec" axis — SPMD via sharding annotations, letting XLA insert the
+collectives (the scaling-book recipe: pick a mesh, annotate, let XLA place
+psum/all-gather on ICI).
+
+Correctness notes:
+- the checksum is an XOR reduction over the entity axis — exact under any
+  sharding (XOR is associative/commutative), so sharded and single-device
+  runs produce bit-identical checksums as long as the state bits match;
+- ``spawn``/``spawn_many`` use cumsum/argmax over the sharded axis, which XLA
+  lowers to scan+collectives — deterministic regardless of layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..app import App
+from ..ops.resim import resim
+from ..snapshot.world import WorldState
+
+DATA_AXIS = "data"
+SPEC_AXIS = "spec"
+
+
+def make_mesh(
+    n_data: Optional[int] = None, n_spec: int = 1, devices=None
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_spec
+    use = np.array(devices[: n_data * n_spec]).reshape(n_data, n_spec)
+    return Mesh(use, (DATA_AXIS, SPEC_AXIS))
+
+
+def world_sharding(reg, mesh: Mesh, world: WorldState):
+    """NamedSharding pytree: capacity-axis leaves shard over "data",
+    scalars/resources replicate."""
+    cap = reg.capacity
+
+    def leaf_sharding(x):
+        if x.ndim >= 1 and x.shape[0] == cap:
+            return NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf_sharding, world)
+
+
+def shard_world(app: App, mesh: Mesh, world: WorldState) -> WorldState:
+    """Place a world onto the mesh with entity-axis sharding."""
+    return jax.device_put(world, world_sharding(app.reg, mesh, world))
+
+
+def make_sharded_resim_fn(app: App, mesh: Mesh):
+    """jit resim with the world sharded over the mesh "data" axis.
+
+    Shapes: inputs_seq [k, P, ...]; returns (final, stacked, checksums) with
+    the same entity-axis sharding on states."""
+    fps, seed, reg, step = app.fps, app.seed, app.reg, app.step
+
+    @jax.jit
+    def fn(world, inputs_seq, status_seq, start_frame, confirmed):
+        return resim(
+            reg, step, world, inputs_seq, status_seq, start_frame, confirmed, fps, seed
+        )
+
+    def wrapped(world, inputs_seq, status_seq, start_frame, confirmed):
+        world = shard_world(app, mesh, world)
+        return fn(world, inputs_seq, status_seq, start_frame, confirmed)
+
+    return wrapped
+
+
+def make_sharded_speculate_fn(app: App, mesh: Mesh):
+    """Speculative fan-out with branches over "spec" x entities over "data".
+
+    ``inputs_branches``: [M, k, P, ...] sharded over the "spec" axis; the
+    broadcast world shards over "data".  One jit call evaluates all branches
+    across the whole mesh."""
+    fps, seed, reg, step = app.fps, app.seed, app.reg, app.step
+
+    @jax.jit
+    def fn(world, inputs_branches, status_branches, start_frame, confirmed):
+        return jax.vmap(
+            lambda inp, stat: resim(
+                reg, step, world, inp, stat, start_frame, confirmed, fps, seed
+            )
+        )(inputs_branches, status_branches)
+
+    def wrapped(world, inputs_branches, status_branches, start_frame, confirmed):
+        world = shard_world(app, mesh, world)
+        spec_sharding = NamedSharding(
+            mesh, P(SPEC_AXIS, *([None] * (inputs_branches.ndim - 1)))
+        )
+        inputs_branches = jax.device_put(inputs_branches, spec_sharding)
+        status_branches = jax.device_put(
+            status_branches,
+            NamedSharding(mesh, P(SPEC_AXIS, *([None] * (status_branches.ndim - 1)))),
+        )
+        return fn(world, inputs_branches, status_branches, start_frame, confirmed)
+
+    return wrapped

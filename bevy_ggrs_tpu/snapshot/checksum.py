@@ -9,17 +9,19 @@ checksum.rs:86-99).  It uses seahash for portability (snapshot/mod.rs:318-320)
 
 TPU equivalent: a murmur3-style multiply-rotate-xor mix over the bit pattern
 of each entity row (two independent 32-bit streams -> one 64-bit checksum),
-masked by liveness, XOR-reduced over the entity axis.  Everything is uint32
-arithmetic, which XLA evaluates bit-identically on CPU and TPU — so checksum
-parity across backends holds whenever the underlying state bits match (for
-float simulation math the bits themselves may differ across backends; see
-docs/determinism.md and the reference's own cross-platform warning,
+masked by liveness, reduced over the entity axis with *wrapping uint32
+addition* instead of the reference's XOR: addition is equally commutative/
+associative (entity-order and sharding independent — a plain ``psum`` on the
+device mesh, exact for integers, where an XOR all-reduce is not universally
+supported by collective backends), and it weakens the XOR blind spot the
+reference documents (checksum.rs:91-93 — two equal parts cancel under XOR but
+not under addition).  Cross-TYPE parts still combine by XOR (scalar,
+replicated, no collective involved).  Everything is uint32 arithmetic, which
+XLA evaluates bit-identically on CPU and TPU — so checksum parity across
+backends holds whenever the underlying state bits match (for float simulation
+math the bits themselves may differ across backends; see docs/determinism.md
+and the reference's own cross-platform warning,
 /root/reference/docs/debugging-desyncs.md:55).
-
-XOR folding is entity-order independent, so sharding the entity axis across
-devices changes nothing (a ``psum``-style XOR all-reduce is exact).  The same
-XOR blind spot the reference documents (checksum.rs:91-93) applies: two equal
-parts cancel.
 """
 
 from __future__ import annotations
@@ -130,12 +132,7 @@ def component_part(
     h = _fold_rows(lanes, tag)
     h = fmix32(mix32(h, w.rollback_id.astype(jnp.uint32)))
     mask = active_mask(w) & w.has[name]
-    part = jax.lax.reduce(
-        jnp.where(mask, h, jnp.uint32(0)),
-        jnp.uint32(0),
-        jax.lax.bitwise_xor,
-        (0,),
-    )
+    part = jnp.sum(jnp.where(mask, h, jnp.uint32(0)), dtype=jnp.uint32)
     return fmix32(part ^ tag)
 
 

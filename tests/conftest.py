@@ -2,14 +2,12 @@
 
 Tests run deterministic logic and mesh-sharding paths on a virtual 8-device
 CPU mesh (no TPU needed); the benchmark (bench.py) runs on real hardware.
-Must run before any jax import.
-"""
 
-import os
+Note: the ambient environment may import jax at interpreter start (TPU tunnel
+sitecustomize) with JAX_PLATFORMS already set, so env vars are too late —
+update the jax config directly instead."""
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
