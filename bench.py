@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Benchmark: resimulation throughput at 8-frame rollback x 10k entities.
+
+Headline metric (BASELINE.md): resim frames/sec — one "resim frame" is a full
+AdvanceWorld + SaveWorld (state + checksum) of the stress workload (10k
+entities, Transform+Velocity).  The device path runs the whole 8-frame
+rollback as ONE jit(lax.scan(step)) call emitting every intermediate state
+and checksum (what the driver actually dispatches on a rollback request).
+
+Baseline: the same semantics implemented as strong vectorized numpy on the
+host CPU — per frame: integrate, bounce, per-entity murmur-fold checksum,
+snapshot copy.  This is a *stronger* baseline than the reference's
+per-entity-HashMap data path (SURVEY §3.6), implemented in
+bench_baselines.py.  vs_baseline = device_fps / numpy_cpu_fps.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+N_ENTITIES = 10_000
+DEPTH = 8
+ITERS = 30
+
+
+def bench_device():
+    import jax
+    from bevy_ggrs_tpu.models import stress
+    from bevy_ggrs_tpu.session.events import InputStatus
+
+    app = stress.make_app(N_ENTITIES)
+    world = app.init_state()
+    inputs = np.zeros((DEPTH, 2), np.uint8)
+    status = np.full((DEPTH, 2), InputStatus.CONFIRMED, np.int8)
+
+    fn = app.resim_fn
+    # warmup/compile
+    final, stacked, checks = fn(world, inputs, status, 0, -1)
+    jax.block_until_ready((final, stacked, checks))
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        final, stacked, checks = fn(world, inputs, status, i, -1)
+    jax.block_until_ready((final, stacked, checks))
+    dt = time.perf_counter() - t0
+    fps = DEPTH * ITERS / dt
+    platform = jax.devices()[0].platform
+    return fps, platform
+
+
+def bench_numpy_baseline():
+    from bench_baselines import NumpyStressSim
+
+    sim = NumpyStressSim(N_ENTITIES, seed=0)
+    sim.resim(DEPTH)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        sim.resim(DEPTH)
+    dt = time.perf_counter() - t0
+    return DEPTH * ITERS / dt
+
+
+def main():
+    device_fps, platform = bench_device()
+    cpu_fps = bench_numpy_baseline()
+    result = {
+        "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
+        "value": round(device_fps, 1),
+        "unit": "frames/s",
+        "vs_baseline": round(device_fps / cpu_fps, 2),
+        "baseline_numpy_cpu_fps": round(cpu_fps, 1),
+        "platform": platform,
+        "entities": N_ENTITIES,
+        "rollback_depth": DEPTH,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

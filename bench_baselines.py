@@ -1,0 +1,74 @@
+"""CPU baselines for bench.py — the same rollback-resim semantics as the
+device path, implemented in strong vectorized numpy (a stricter baseline than
+the reference's per-entity HashMap save/load path, SURVEY §3.6)."""
+
+import numpy as np
+
+GRAVITY = np.float32(-9.8)
+BOUND = np.float32(50.0)
+DT = np.float32(1.0 / 60.0)
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _rotl(x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix32(h, k):
+    with np.errstate(over="ignore"):
+        k = k * _C1
+        k = _rotl(k, 15)
+        k = k * _C2
+        h = h ^ k
+        h = _rotl(h, 13)
+        return h * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _fmix32(h):
+    with np.errstate(over="ignore"):
+        h = h ^ (h >> np.uint32(16))
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> np.uint32(13))
+        h = h * np.uint32(0xC2B2AE35)
+        return h ^ (h >> np.uint32(16))
+
+
+class NumpyStressSim:
+    """10k-entity Transform+Velocity sim: advance + checksum + snapshot/frame."""
+
+    def __init__(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        self.pos = rng.uniform(-40, 40, (n, 3)).astype(np.float32)
+        self.vel = rng.uniform(-5, 5, (n, 3)).astype(np.float32)
+        self.ids = np.arange(n, dtype=np.uint32)
+
+    def advance(self):
+        self.vel = self.vel + np.array([0, GRAVITY, 0], np.float32) * DT
+        self.pos = self.pos + self.vel * DT
+        over = np.abs(self.pos) > BOUND
+        self.vel = np.where(over, -self.vel, self.vel)
+        self.pos = np.clip(self.pos, -BOUND, BOUND)
+
+    def checksum(self):
+        parts = []
+        for col in (self.pos, self.vel):
+            lanes = col.view(np.uint32)
+            h = np.full(col.shape[0], 0x9E3779B9, np.uint32)
+            for i in range(lanes.shape[1]):
+                h = _mix32(h, lanes[:, i])
+            h = _fmix32(_mix32(_fmix32(h), self.ids))
+            with np.errstate(over="ignore"):
+                parts.append(_fmix32(np.sum(h, dtype=np.uint32)))
+        return parts[0] ^ parts[1]
+
+    def resim(self, depth):
+        """One rollback batch: depth x (advance + save(state copy + checksum))."""
+        out = 0
+        snapshots = []
+        for _ in range(depth):
+            self.advance()
+            snapshots.append((self.pos.copy(), self.vel.copy()))
+            out ^= int(self.checksum())
+        return out

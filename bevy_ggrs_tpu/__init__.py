@@ -15,6 +15,10 @@ from .runner import GgrsRunner
 from .ops.resim import StepCtx, select_branch, slice_frame
 from .session import (
     SyncTestSession,
+    P2PSession,
+    SpectatorSession,
+    SessionBuilder,
+    UdpNonBlockingSocket,
     InputStatus,
     SessionState,
     PlayerType,

@@ -23,10 +23,12 @@ INPUT_DOWN = 1 << 1
 INPUT_LEFT = 1 << 2
 INPUT_RIGHT = 1 << 3
 
-MOVEMENT_SPEED = jnp.float32(0.005)
-MAX_SPEED = jnp.float32(0.05)
-FRICTION = jnp.float32(0.9975)
-ARENA_HALF = jnp.float32(4.0)
+# numpy scalars (not jnp): module-level device arrays captured in jit are a
+# measured per-call slow path on the TPU tunnel; numpy embeds as literals
+MOVEMENT_SPEED = np.float32(0.005)
+MAX_SPEED = np.float32(0.05)
+FRICTION = np.float32(0.9975)
+ARENA_HALF = np.float32(4.0)
 
 
 def step(world: WorldState, ctx: StepCtx) -> WorldState:

@@ -26,7 +26,7 @@ from ..app import App
 from ..ops.resim import StepCtx
 from ..snapshot.world import WorldState, active_mask, despawn_where, spawn_many
 
-GRAVITY = jnp.float32(-9.8)
+GRAVITY = np.float32(-9.8)
 DEFAULT_TTL = 120  # frames (2 s at 60 fps, particles.rs ttl)
 
 

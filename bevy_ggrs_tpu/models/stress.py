@@ -1,0 +1,56 @@
+"""stress — the benchmark workload: N pre-spawned entities with
+Transform+Velocity, integrated under gravity with arena bounces, 8-frame
+rollback resimulation (BASELINE.md config 3: "10k entities,
+Transform+Velocity, 8-frame rollback")."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..app import App
+from ..snapshot.world import active_mask, spawn_many
+
+GRAVITY = np.float32(-9.8)
+BOUND = np.float32(50.0)
+
+
+def step(world, ctx):
+    m = active_mask(world)[:, None]
+    vel = world.comps["vel"] + jnp.array([0.0, GRAVITY, 0.0]) * ctx.delta_seconds
+    pos = world.comps["pos"] + vel * ctx.delta_seconds
+    # elastic bounce at the arena bounds
+    over = jnp.abs(pos) > BOUND
+    vel = jnp.where(over, -vel, vel)
+    pos = jnp.clip(pos, -BOUND, BOUND)
+    return dataclasses.replace(
+        world,
+        comps={
+            "pos": jnp.where(m, pos, world.comps["pos"]),
+            "vel": jnp.where(m, vel, world.comps["vel"]),
+        },
+    )
+
+
+def make_app(n_entities: int = 10_000, capacity: int | None = None, fps: int = 60,
+             checksum: bool = True, seed: int = 0) -> App:
+    capacity = capacity or n_entities
+    app = App(num_players=2, capacity=capacity, fps=fps,
+              input_shape=(), input_dtype=np.uint8, seed=seed)
+    app.rollback_component("pos", (3,), jnp.float32, checksum=checksum)
+    app.rollback_component("vel", (3,), jnp.float32, checksum=checksum)
+    app.set_step(step)
+
+    def setup(world):
+        rng = np.random.default_rng(seed)
+        pos = rng.uniform(-40, 40, (n_entities, 3)).astype(np.float32)
+        vel = rng.uniform(-5, 5, (n_entities, 3)).astype(np.float32)
+        return spawn_many(
+            app.reg, world, {"pos": jnp.asarray(pos), "vel": jnp.asarray(vel)},
+            count=n_entities,
+        )
+
+    app.set_setup(setup)
+    return app

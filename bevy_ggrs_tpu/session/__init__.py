@@ -19,6 +19,12 @@ from .events import (
 )
 from .requests import SaveRequest, LoadRequest, AdvanceRequest, SaveCell, GgrsRequest
 from .synctest import SyncTestSession
+from .input_queue import InputQueue
+from .time_sync import TimeSync
+from .transport import UdpNonBlockingSocket, NonBlockingSocket
+from .p2p import P2PSession
+from .spectator import SpectatorSession
+from .builder import SessionBuilder
 
 __all__ = [
     "InputStatus",
@@ -44,4 +50,11 @@ __all__ = [
     "SaveCell",
     "GgrsRequest",
     "SyncTestSession",
+    "InputQueue",
+    "TimeSync",
+    "UdpNonBlockingSocket",
+    "NonBlockingSocket",
+    "P2PSession",
+    "SpectatorSession",
+    "SessionBuilder",
 ]

@@ -1,0 +1,116 @@
+"""SessionBuilder — the fluent session construction surface (SURVEY §2.3:
+``with_num_players``, ``with_max_prediction_window``, ``with_input_delay``,
+``with_check_distance``, ``with_desync_detection_mode``, ``add_player``,
+``start_{p2p,synctest,spectator}_session``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .events import DesyncDetection, InvalidRequestError, Player, PlayerType
+from .p2p import P2PSession
+from .spectator import SpectatorSession
+from .synctest import SyncTestSession
+
+
+class SessionBuilder:
+    def __init__(self, input_shape: Tuple[int, ...] = (), input_dtype=np.uint8):
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self._num_players = 2
+        self._max_prediction = 8
+        self._input_delay = 0
+        self._check_distance = 2
+        self._desync = DesyncDetection.OFF
+        self._players: List[Player] = []
+        self._disconnect_timeout_s = 2.0
+        self._disconnect_notify_start_s = 0.5
+        self._sparse_saving = False
+
+    @classmethod
+    def for_app(cls, app) -> "SessionBuilder":
+        b = cls(app.input_shape, app.input_dtype)
+        b._num_players = app.num_players
+        return b
+
+    def with_num_players(self, n: int) -> "SessionBuilder":
+        if n < 1:
+            raise InvalidRequestError("num_players must be >= 1")
+        self._num_players = n
+        return self
+
+    def with_max_prediction_window(self, n: int) -> "SessionBuilder":
+        self._max_prediction = n
+        return self
+
+    def with_input_delay(self, n: int) -> "SessionBuilder":
+        self._input_delay = n
+        return self
+
+    def with_check_distance(self, n: int) -> "SessionBuilder":
+        self._check_distance = n
+        return self
+
+    def with_desync_detection_mode(self, mode: DesyncDetection) -> "SessionBuilder":
+        self._desync = mode
+        return self
+
+    def with_disconnect_timeout(self, seconds: float) -> "SessionBuilder":
+        self._disconnect_timeout_s = seconds
+        return self
+
+    def with_disconnect_notify_delay(self, seconds: float) -> "SessionBuilder":
+        self._disconnect_notify_start_s = seconds
+        return self
+
+    def add_player(self, kind: PlayerType, handle: int, address: Any = None) -> "SessionBuilder":
+        if kind != PlayerType.SPECTATOR and not (0 <= handle < self._num_players):
+            raise InvalidRequestError(
+                f"player handle {handle} out of range 0..{self._num_players}"
+            )
+        if kind in (PlayerType.REMOTE, PlayerType.SPECTATOR) and address is None:
+            raise InvalidRequestError(f"{kind} player needs an address")
+        self._players.append(Player(kind, handle, address))
+        return self
+
+    def start_p2p_session(self, socket) -> P2PSession:
+        handles = {p.handle for p in self._players if p.kind != PlayerType.SPECTATOR}
+        if handles != set(range(self._num_players)):
+            raise InvalidRequestError(
+                f"players incomplete: have handles {sorted(handles)}"
+            )
+        return P2PSession(
+            num_players=self._num_players,
+            players=self._players,
+            socket=socket,
+            input_shape=self.input_shape,
+            input_dtype=self.input_dtype,
+            max_prediction=self._max_prediction,
+            input_delay=self._input_delay,
+            desync_detection=self._desync,
+            disconnect_timeout_s=self._disconnect_timeout_s,
+            disconnect_notify_start_s=self._disconnect_notify_start_s,
+        )
+
+    def start_synctest_session(self) -> SyncTestSession:
+        return SyncTestSession(
+            num_players=self._num_players,
+            input_shape=self.input_shape,
+            input_dtype=self.input_dtype,
+            check_distance=self._check_distance,
+            input_delay=self._input_delay,
+            max_prediction=self._max_prediction,
+        )
+
+    def start_spectator_session(self, host_addr: Any, socket) -> SpectatorSession:
+        return SpectatorSession(
+            num_players=self._num_players,
+            host_addr=host_addr,
+            socket=socket,
+            input_shape=self.input_shape,
+            input_dtype=self.input_dtype,
+            disconnect_timeout_s=self._disconnect_timeout_s,
+            disconnect_notify_start_s=self._disconnect_notify_start_s,
+        )

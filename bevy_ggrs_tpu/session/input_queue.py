@@ -1,0 +1,104 @@
+"""Per-player input queues: delay, prediction, misprediction detection.
+
+The ggrs-core surface reconstructed in SURVEY §2.3: inputs are delayed by
+``input_delay`` frames at add time, remote inputs are predicted by repeating
+the last confirmed input (``PredictRepeatLast``, /root/reference/src/lib.rs:59),
+and the queue records every prediction it serves so the arrival of the real
+input can report the *first incorrect frame* — the rollback target."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.frames import NULL_FRAME, frame_gt, frame_le, frame_lt
+from .events import InputStatus
+
+
+class InputQueue:
+    def __init__(self, input_shape=(), input_dtype=np.uint8, delay: int = 0):
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.delay = int(delay)
+        self._inputs: Dict[int, np.ndarray] = {}  # frame -> effective input
+        self.last_confirmed = NULL_FRAME  # newest frame with a real input
+        self._predictions: Dict[int, np.ndarray] = {}  # frame -> served guess
+        self.first_incorrect = NULL_FRAME
+
+    def default_input(self) -> np.ndarray:
+        return np.zeros(self.input_shape, self.input_dtype)
+
+    # -- adding real inputs -------------------------------------------------
+
+    def add_local(self, frame: int, value) -> int:
+        """Add a local input at ``frame``; lands at ``frame + delay``.
+        Returns the effective frame."""
+        eff = frame + self.delay
+        self._store(eff, np.asarray(value, self.input_dtype).reshape(self.input_shape))
+        return eff
+
+    def add_remote(self, frame: int, value) -> None:
+        """Add a remote input already carrying its effective frame (the sender
+        applied its own delay)."""
+        self._store(frame, np.asarray(value, self.input_dtype).reshape(self.input_shape))
+
+    def _store(self, frame: int, value: np.ndarray) -> None:
+        if frame_le(frame, self.last_confirmed) and self.last_confirmed != NULL_FRAME:
+            return  # duplicate / out-of-order redundancy
+        self._inputs[frame] = value
+        self.last_confirmed = frame
+        served = self._predictions.pop(frame, None)
+        if served is not None and not np.array_equal(served, value):
+            if self.first_incorrect == NULL_FRAME or frame_lt(
+                frame, self.first_incorrect
+            ):
+                self.first_incorrect = frame
+
+    # -- reading ------------------------------------------------------------
+
+    def input_for(self, frame: int) -> Tuple[np.ndarray, InputStatus]:
+        """Input to use when advancing ``frame`` -> ``frame+1``.
+
+        Confirmed if a real input exists; otherwise PredictRepeatLast, with
+        the served guess recorded for later misprediction detection."""
+        if frame in self._inputs:
+            return self._inputs[frame], InputStatus.CONFIRMED
+        if self.last_confirmed != NULL_FRAME and frame_le(frame, self.last_confirmed):
+            # gap below the newest confirmed input (lost packet midstream):
+            # predict from the nearest earlier confirmed frame
+            pred = self._nearest_before(frame)
+        elif self.last_confirmed == NULL_FRAME:
+            pred = self.default_input()
+        else:
+            pred = self._inputs[self.last_confirmed]
+        self._predictions[frame] = pred
+        return pred, InputStatus.PREDICTED
+
+    def _nearest_before(self, frame: int) -> np.ndarray:
+        best, best_f = self.default_input(), None
+        for f, v in self._inputs.items():
+            if frame_lt(f, frame) and (best_f is None or frame_gt(f, best_f)):
+                best, best_f = v, f
+        return best
+
+    def confirmed_input(self, frame: int) -> Optional[np.ndarray]:
+        return self._inputs.get(frame)
+
+    def take_first_incorrect(self) -> int:
+        f = self.first_incorrect
+        self.first_incorrect = NULL_FRAME
+        return f
+
+    def inputs_since(self, frame: int) -> list[tuple[int, np.ndarray]]:
+        """All confirmed inputs with frame > ``frame``, ascending (for
+        redundant INPUT packets)."""
+        out = [(f, v) for f, v in self._inputs.items() if frame_gt(f, frame)]
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def gc(self, before_frame: int) -> None:
+        """Drop inputs/predictions older than ``before_frame``."""
+        for d in (self._inputs, self._predictions):
+            for f in [f for f in d if frame_lt(f, before_frame)]:
+                del d[f]

@@ -1,0 +1,394 @@
+"""P2PSession — rollback netcode over a non-blocking socket.
+
+The ggrs-core P2P surface reconstructed in SURVEY §2.3:
+``poll_remote_clients`` drains the socket and drives per-peer protocol state;
+``advance_frame`` decides save/rollback/advance and returns the request
+stream; ``frames_ahead`` drives run-slow; events surface network lifecycle
+and desyncs.  Frame semantics: the input added at frame f (after input
+delay) governs the f -> f+1 transition; a mispredicted remote input at frame
+F invalidates states > F, so the session requests Load(F) then
+(Advance, Save) x (current - F) — which the driver fuses into one device
+call (docs/architecture.md:21 request shapes)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.frames import NULL_FRAME, frame_gt, frame_le, frame_lt, frame_min
+from .events import (
+    DesyncDetected,
+    DesyncDetection,
+    InputStatus,
+    InvalidRequestError,
+    NetworkStats,
+    NotSynchronizedError,
+    Player,
+    PlayerType,
+    PredictionThresholdError,
+    SessionState,
+)
+from .input_queue import InputQueue
+from .protocol import PeerEndpoint
+from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
+
+
+class P2PSession:
+    def __init__(
+        self,
+        num_players: int,
+        players: List[Player],
+        socket,
+        input_shape=(),
+        input_dtype=np.uint8,
+        max_prediction: int = 8,
+        input_delay: int = 0,
+        desync_detection: DesyncDetection = DesyncDetection.OFF,
+        disconnect_timeout_s: float = 2.0,
+        disconnect_notify_start_s: float = 0.5,
+        sparse_saving: bool = False,
+    ):
+        self._num_players = num_players
+        self.socket = socket
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.input_size = int(np.prod(self.input_shape, dtype=int) or 1) * self.input_dtype.itemsize
+        self._max_prediction = max_prediction
+        self.input_delay = input_delay
+        self.desync_detection = desync_detection
+        self.current_frame = 0
+        self._confirmed = NULL_FRAME
+        self.events_buf: List = []
+        self._staged: Dict[int, np.ndarray] = {}
+        self.sparse_saving = sparse_saving
+
+        self.local_handles: List[int] = []
+        self.remote_handle_addr: Dict[int, Any] = {}
+        self.spectator_addrs: List[Any] = []
+        for p in players:
+            if p.kind == PlayerType.LOCAL:
+                self.local_handles.append(p.handle)
+            elif p.kind == PlayerType.REMOTE:
+                self.remote_handle_addr[p.handle] = p.address
+            else:
+                self.spectator_addrs.append(p.address)
+
+        self.queues: Dict[int, InputQueue] = {
+            h: InputQueue(self.input_shape, self.input_dtype,
+                          delay=input_delay if h in self.local_handles else 0)
+            for h in range(num_players)
+        }
+
+        self._handle_of_addr: Dict[Any, List[int]] = {}
+        for h, a in self.remote_handle_addr.items():
+            self._handle_of_addr.setdefault(a, []).append(h)
+        for a in self._handle_of_addr:
+            self._handle_of_addr[a].sort()
+
+        self.endpoints: Dict[Any, PeerEndpoint] = {}
+        rng = random.Random(id(self) ^ random.getrandbits(32))
+        peer_addrs = sorted(
+            {a for a in self.remote_handle_addr.values()}, key=repr
+        )
+        for addr in peer_addrs:
+            ep = PeerEndpoint(
+                send=(lambda data, a=addr: self.socket.send_to(data, a)),
+                # the peer streams THEIR local inputs: one row per handle they own
+                input_size=self.input_size * len(self._handle_of_addr[addr]),
+                rng_nonce=rng.getrandbits(32),
+                disconnect_timeout_s=disconnect_timeout_s,
+                disconnect_notify_start_s=disconnect_notify_start_s,
+                addr=addr,
+            )
+            ep.on_input = self._make_on_input(addr)
+            ep.on_checksum = self._make_on_checksum(addr)
+            self.endpoints[addr] = ep
+        # spectator endpoints: we stream all-player confirmed inputs to them
+        self.spectator_endpoints: Dict[Any, PeerEndpoint] = {}
+        for addr in self.spectator_addrs:
+            ep = PeerEndpoint(
+                send=(lambda data, a=addr: self.socket.send_to(data, a)),
+                input_size=self.input_size * num_players,
+                rng_nonce=rng.getrandbits(32),
+                disconnect_timeout_s=disconnect_timeout_s,
+                disconnect_notify_start_s=disconnect_notify_start_s,
+                addr=addr,
+            )
+            self.spectator_endpoints[addr] = ep
+        # local input bytes pending ack, per remote peer: [(frame, bytes)]
+        self._local_sent: List[Tuple[int, bytes]] = []
+        # confirmed-input packets pending for spectators
+        self._spectator_sent: List[Tuple[int, bytes]] = []
+        self._next_spectator_frame = 0
+        # desync bookkeeping: frame -> checksum provider / forced value
+        self._local_checksums: Dict[int, Any] = {}
+        self._remote_checksums: Dict[Tuple[Any, int], int] = {}
+
+    # -- GGRS session surface ----------------------------------------------
+
+    def num_players(self) -> int:
+        return self._num_players
+
+    def max_prediction(self) -> int:
+        return self._max_prediction
+
+    def confirmed_frame(self) -> int:
+        return self._confirmed
+
+    def local_player_handles(self) -> List[int]:
+        return list(self.local_handles)
+
+    def current_state(self) -> SessionState:
+        eps = list(self.endpoints.values()) + list(self.spectator_endpoints.values())
+        if all(ep.state == SessionState.RUNNING or ep.disconnected for ep in eps):
+            return SessionState.RUNNING
+        return SessionState.SYNCHRONIZING
+
+    def frames_ahead(self) -> int:
+        vals = [
+            ep.time_sync.frames_ahead()
+            for ep in self.endpoints.values()
+            if not ep.disconnected
+        ]
+        return max(vals) if vals else 0
+
+    def events(self):
+        out, self.events_buf = self.events_buf, []
+        return out
+
+    def network_stats(self, handle: int) -> NetworkStats:
+        addr = self.remote_handle_addr.get(handle)
+        if addr is None or addr not in self.endpoints:
+            raise InvalidRequestError(f"no remote endpoint for handle {handle}")
+        return self.endpoints[addr].stats()
+
+    # -- polling ------------------------------------------------------------
+
+    def poll_remote_clients(self) -> None:
+        """Drain the socket, drive protocol timers, surface events
+        (the process/network boundary, SURVEY §3.1)."""
+        for addr, data in self.socket.receive_all():
+            ep = self.endpoints.get(addr) or self.spectator_endpoints.get(addr)
+            if ep is not None:
+                ep.handle(data)
+        all_eps = list(self.endpoints.values()) + list(self.spectator_endpoints.values())
+        for ep in all_eps:
+            ep.local_advantage = self._local_advantage(ep)
+            ep.poll()
+            self.events_buf.extend(ep.events)
+            ep.events.clear()
+        # retransmit un-acked local inputs + acks
+        for ep in self.endpoints.values():
+            if ep.state == SessionState.RUNNING and not ep.disconnected:
+                ep.send_inputs(self._local_sent)
+        for ep in self.spectator_endpoints.values():
+            if ep.state == SessionState.RUNNING and not ep.disconnected:
+                ep.send_inputs(self._spectator_sent)
+        self._drive_desync_detection()
+
+    def _local_advantage(self, ep: PeerEndpoint) -> int:
+        if ep.last_received_frame == NULL_FRAME:
+            return 0
+        adv = self.current_frame - ep.last_received_frame
+        ep.time_sync.note_local(self.current_frame, ep.last_received_frame)
+        return adv
+
+    def _make_on_input(self, addr):
+        handles = sorted(self.remote_handle_addr)
+
+        def cb(frame: int, raw: bytes) -> None:
+            hs = self._handle_of_addr[addr]
+            for i, h in enumerate(hs):
+                chunk = raw[i * self.input_size:(i + 1) * self.input_size]
+                value = np.frombuffer(chunk, self.input_dtype).reshape(
+                    self.input_shape
+                )
+                self.queues[h].add_remote(frame, value)
+
+        return cb
+
+    def _make_on_checksum(self, addr):
+        def cb(frame: int, checksum: int) -> None:
+            self._remote_checksums[(addr, frame)] = checksum
+
+        return cb
+
+    # -- advancing ----------------------------------------------------------
+
+    def add_local_input(self, handle: int, value) -> None:
+        if handle not in self.local_handles:
+            raise InvalidRequestError(f"handle {handle} is not local")
+        if self.current_state() != SessionState.RUNNING:
+            raise NotSynchronizedError()
+        self._staged[handle] = np.asarray(value, self.input_dtype).reshape(
+            self.input_shape
+        )
+
+    def advance_frame(self) -> List:
+        if self.current_state() != SessionState.RUNNING:
+            raise NotSynchronizedError()
+        missing = set(self.local_handles) - set(self._staged)
+        if missing:
+            raise InvalidRequestError(f"missing local input for {sorted(missing)}")
+
+        # stall check BEFORE consuming inputs, so the tick can retry
+        new_confirmed = self._compute_confirmed()
+        if self.current_frame - new_confirmed > self._max_prediction:
+            self._staged.clear()
+            raise PredictionThresholdError()
+
+        # commit local inputs (delay applied by the queue) + broadcast
+        eff_frames = {}
+        for h in self.local_handles:
+            eff_frames[h] = self.queues[h].add_local(
+                self.current_frame, self._staged[h]
+            )
+        self._staged.clear()
+        eff = eff_frames[self.local_handles[0]] if self.local_handles else None
+        if eff is not None:
+            raw = b"".join(
+                np.ascontiguousarray(
+                    self.queues[h].confirmed_input(eff)
+                ).tobytes()
+                for h in self.local_handles
+            )
+            self._local_sent.append((eff, raw))
+            for ep in self.endpoints.values():
+                if ep.state == SessionState.RUNNING and not ep.disconnected:
+                    ep.send_inputs(self._local_sent)
+
+        requests: List = []
+
+        # rollback on misprediction
+        first_incorrect = NULL_FRAME
+        for q in self.queues.values():
+            f = q.take_first_incorrect()
+            if f != NULL_FRAME and (
+                first_incorrect == NULL_FRAME or frame_lt(f, first_incorrect)
+            ):
+                first_incorrect = f
+        rolled_back = False
+        if first_incorrect != NULL_FRAME and frame_lt(
+            first_incorrect, self.current_frame
+        ):
+            requests.append(LoadRequest(first_incorrect))
+            for i in range(first_incorrect, self.current_frame):
+                inputs, status = self._inputs_for(i)
+                requests.append(AdvanceRequest(inputs, status))
+                requests.append(SaveRequest(i + 1, SaveCell(self, i + 1)))
+            rolled_back = True
+
+        self._confirmed = new_confirmed
+        self._gc()
+
+        if not rolled_back:
+            requests.append(
+                SaveRequest(self.current_frame, SaveCell(self, self.current_frame))
+            )
+        inputs, status = self._inputs_for(self.current_frame)
+        requests.append(AdvanceRequest(inputs, status))
+        self.current_frame += 1
+        self._stream_confirmed_to_spectators()
+        return requests
+
+    def _inputs_for(self, frame: int) -> Tuple[np.ndarray, np.ndarray]:
+        inputs = np.zeros((self._num_players, *self.input_shape), self.input_dtype)
+        status = np.zeros((self._num_players,), np.int8)
+        for h in range(self._num_players):
+            if (
+                h in self.remote_handle_addr
+                and self.endpoints[self.remote_handle_addr[h]].disconnected
+            ):
+                status[h] = InputStatus.DISCONNECTED
+                continue
+            value, st = self.queues[h].input_for(frame)
+            inputs[h] = value
+            status[h] = st
+        return inputs, status
+
+    def _compute_confirmed(self) -> int:
+        c = self.current_frame
+        for h, addr in self.remote_handle_addr.items():
+            if self.endpoints[addr].disconnected:
+                continue
+            c = frame_min(c, self.queues[h].last_confirmed)
+        return c
+
+    def _gc(self) -> None:
+        horizon = self._confirmed - self._max_prediction - 2
+        for q in self.queues.values():
+            q.gc(horizon)
+        acked = min(
+            (ep.last_acked for ep in self.endpoints.values()), default=NULL_FRAME
+        )
+        self._local_sent = [
+            p for p in self._local_sent
+            if acked == NULL_FRAME or frame_gt(p[0], acked)
+        ]
+        for fr in [f for f in self._local_checksums if frame_lt(f, horizon)]:
+            del self._local_checksums[fr]
+        for key in [k for k in self._remote_checksums if frame_lt(k[1], horizon)]:
+            del self._remote_checksums[key]
+
+    # -- spectator streaming -------------------------------------------------
+
+    def _stream_confirmed_to_spectators(self) -> None:
+        if not self.spectator_endpoints:
+            return
+        while frame_le(self._next_spectator_frame, self._confirmed):
+            f = self._next_spectator_frame
+            rows = []
+            for h in range(self._num_players):
+                v = self.queues[h].confirmed_input(f)
+                if v is None:
+                    v = self.queues[h].default_input()
+                rows.append(np.ascontiguousarray(v).tobytes())
+            self._spectator_sent.append((f, b"".join(rows)))
+            self._next_spectator_frame += 1
+        acked = min(
+            (ep.last_acked for ep in self.spectator_endpoints.values()),
+            default=NULL_FRAME,
+        )
+        if acked != NULL_FRAME:
+            self._spectator_sent = [
+                p for p in self._spectator_sent if frame_gt(p[0], acked)
+            ]
+
+    # -- desync detection ----------------------------------------------------
+
+    def _on_cell_saved(self, frame: int, provider) -> None:
+        if self.desync_detection.enabled:
+            self._local_checksums[frame] = provider
+
+    def _drive_desync_detection(self) -> None:
+        if not self.desync_detection.enabled:
+            return
+        interval = self.desync_detection.interval
+        for frame in sorted(self._local_checksums):
+            if frame % interval != 0 or not frame_le(frame, self._confirmed):
+                continue
+            entry = self._local_checksums[frame]
+            if callable(entry):
+                entry = entry()
+                if entry is None:
+                    continue
+                entry &= 2**64 - 1
+                self._local_checksums[frame] = entry
+                for ep in self.endpoints.values():
+                    if not ep.disconnected and ep.state == SessionState.RUNNING:
+                        ep.send_checksum(frame, entry)
+            # compare against any received reports
+            for (addr, f), remote in list(self._remote_checksums.items()):
+                if f == frame:
+                    if remote != entry:
+                        self.events_buf.append(
+                            DesyncDetected(
+                                frame=f,
+                                local_checksum=entry,
+                                remote_checksum=remote,
+                                addr=addr,
+                            )
+                        )
+                    del self._remote_checksums[(addr, f)]

@@ -1,0 +1,270 @@
+"""Wire protocol + per-peer endpoint state machine.
+
+The network core hidden behind ``poll_remote_clients``/``advance_frame`` in
+the reference's ggrs dependency (SURVEY §5.8): non-blocking UDP, poll-driven,
+with sync handshake, redundant input packets, input acks, quality
+reports (ping + frame advantage), keepalives, disconnect detection, and
+desync-detection checksum reports.
+
+The byte format is little-endian and fixed (shared with the native C++ core
+in native/ggrs_core — keep in sync with message.h):
+
+    header:  magic:u16  type:u8
+    SYNC_REQ   nonce:u32
+    SYNC_REP   nonce:u32
+    INPUT      start_frame:i32 count:u16 ack_frame:i32 advantage:i8
+               payload: count * input_size bytes
+    INPUT_ACK  ack_frame:i32
+    QUAL_REQ   ping_ts_us:u64 advantage:i8
+    QUAL_REP   pong_ts_us:u64
+    KEEP_ALIVE (empty)
+    CHECKSUM   frame:i32 checksum:u64
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.frames import NULL_FRAME, frame_gt
+from .events import (
+    Disconnected,
+    NetworkInterrupted,
+    NetworkResumed,
+    SessionState,
+    Synchronized,
+    Synchronizing,
+    NetworkStats,
+)
+from .time_sync import TimeSync
+
+MAGIC = 0x47A7  # "GGRS-TPU"
+HDR = struct.Struct("<HB")
+
+T_SYNC_REQ = 1
+T_SYNC_REP = 2
+T_INPUT = 3
+T_INPUT_ACK = 4
+T_QUAL_REQ = 5
+T_QUAL_REP = 6
+T_KEEP_ALIVE = 7
+T_CHECKSUM = 8
+
+S_SYNC_REQ = struct.Struct("<I")
+S_SYNC_REP = struct.Struct("<I")
+S_INPUT = struct.Struct("<iHib")
+S_INPUT_ACK = struct.Struct("<i")
+S_QUAL_REQ = struct.Struct("<Qb")
+S_QUAL_REP = struct.Struct("<Q")
+S_CHECKSUM = struct.Struct("<iQ")
+
+NUM_SYNC_ROUNDTRIPS = 5
+SYNC_RETRY_S = 0.06
+QUALITY_INTERVAL_S = 0.2
+KEEP_ALIVE_S = 0.2
+MAX_INPUTS_PER_PACKET = 64
+
+
+def now_s() -> float:
+    return time.monotonic()
+
+
+class PeerEndpoint:
+    """Protocol state machine for one remote peer address.
+
+    Handles sync, input exchange (with redundancy + ack), quality/ping,
+    keepalive/disconnect and checksum reports.  Transport-agnostic: ``send``
+    is a callable taking raw bytes."""
+
+    def __init__(
+        self,
+        send: Callable[[bytes], None],
+        input_size: int,
+        rng_nonce: int,
+        disconnect_timeout_s: float = 2.0,
+        disconnect_notify_start_s: float = 0.5,
+        addr=None,
+    ):
+        self.send_raw = send
+        self.addr = addr
+        self.input_size = input_size
+        self.state = SessionState.SYNCHRONIZING
+        self._sync_nonce = rng_nonce & 0xFFFFFFFF
+        self._sync_remaining = NUM_SYNC_ROUNDTRIPS
+        self._last_sync_sent = 0.0
+        self.disconnect_timeout_s = disconnect_timeout_s
+        self.disconnect_notify_start_s = disconnect_notify_start_s
+        self._last_recv = now_s()
+        self._last_send = 0.0
+        self._last_quality_sent = 0.0
+        self.interrupted = False
+        self.disconnected = False
+        self.events: List = []
+        self.time_sync = TimeSync()
+        # input plumbing (frames are EFFECTIVE frames, delay already applied)
+        self.last_acked = NULL_FRAME  # newest of our inputs the peer has
+        self.last_received_frame = NULL_FRAME  # newest peer input we have
+        self.on_input: Optional[Callable[[int, bytes], None]] = None
+        self.on_checksum: Optional[Callable[[int, int], None]] = None
+        self.local_advantage = 0  # set by session before poll
+        # stats
+        self.ping_s = 0.0
+        self.bytes_sent = 0
+        self._created = now_s()
+        self.send_queue_len = 0
+        self.remote_advantage = 0
+
+    # -- sending ------------------------------------------------------------
+
+    def _send(self, t: int, body: bytes = b"") -> None:
+        data = HDR.pack(MAGIC, t) + body
+        self.bytes_sent += len(data)
+        self._last_send = now_s()
+        self.send_raw(data)
+
+    def send_inputs(self, pending: List[Tuple[int, bytes]]) -> None:
+        """Send all un-acked inputs (redundant packet).  ``pending`` is an
+        ascending [(effective_frame, raw_bytes)] list."""
+        pending = [p for p in pending if frame_gt(p[0], self.last_acked)]
+        pending = pending[-MAX_INPUTS_PER_PACKET:]
+        self.send_queue_len = len(pending)
+        if not pending:
+            return
+        start = pending[0][0]
+        body = S_INPUT.pack(
+            start, len(pending), self.last_received_frame,
+            int(np.clip(self.local_advantage, -127, 127)),
+        )
+        body += b"".join(p[1] for p in pending)
+        self._send(T_INPUT, body)
+
+    def send_input_ack(self) -> None:
+        self._send(T_INPUT_ACK, S_INPUT_ACK.pack(self.last_received_frame))
+
+    def send_checksum(self, frame: int, checksum: int) -> None:
+        self._send(T_CHECKSUM, S_CHECKSUM.pack(frame, checksum & (2**64 - 1)))
+
+    # -- receiving ----------------------------------------------------------
+
+    def handle(self, data: bytes) -> None:
+        if len(data) < HDR.size:
+            return
+        magic, t = HDR.unpack_from(data)
+        if magic != MAGIC:
+            return
+        body = data[HDR.size:]
+        was_quiet = self.interrupted
+        self._last_recv = now_s()
+        if self.interrupted:
+            self.interrupted = False
+            self.events.append(NetworkResumed(self.addr))
+        if t == T_SYNC_REQ:
+            (nonce,) = S_SYNC_REQ.unpack_from(body)
+            self._send(T_SYNC_REP, S_SYNC_REP.pack(nonce))
+        elif t == T_SYNC_REP:
+            (nonce,) = S_SYNC_REP.unpack_from(body)
+            if self.state == SessionState.SYNCHRONIZING and nonce == self._sync_nonce:
+                self._sync_remaining -= 1
+                self._sync_nonce = (self._sync_nonce * 6364136223846793005 + 1) & 0xFFFFFFFF
+                self.events.append(
+                    Synchronizing(
+                        self.addr,
+                        NUM_SYNC_ROUNDTRIPS,
+                        NUM_SYNC_ROUNDTRIPS - self._sync_remaining,
+                    )
+                )
+                if self._sync_remaining <= 0:
+                    self.state = SessionState.RUNNING
+                    self.events.append(Synchronized(self.addr))
+                else:
+                    # continue the handshake immediately (RTT-bound, not
+                    # retry-timer-bound); the timer only covers loss
+                    self._last_sync_sent = now_s()
+                    self._send(T_SYNC_REQ, S_SYNC_REQ.pack(self._sync_nonce))
+        elif t == T_INPUT:
+            start, count, ack, adv = S_INPUT.unpack_from(body)
+            self._note_ack(ack)
+            self.time_sync.note_remote(adv)
+            self.remote_advantage = adv
+            payload = body[S_INPUT.size:]
+            for i in range(count):
+                f = start + i
+                raw = payload[i * self.input_size:(i + 1) * self.input_size]
+                if len(raw) < self.input_size:
+                    break
+                if self.last_received_frame == NULL_FRAME or frame_gt(
+                    f, self.last_received_frame
+                ):
+                    self.last_received_frame = f
+                    if self.on_input:
+                        self.on_input(f, raw)
+        elif t == T_INPUT_ACK:
+            (ack,) = S_INPUT_ACK.unpack_from(body)
+            self._note_ack(ack)
+        elif t == T_QUAL_REQ:
+            ts, adv = S_QUAL_REQ.unpack_from(body)
+            self.time_sync.note_remote(adv)
+            self.remote_advantage = adv
+            self._send(T_QUAL_REP, S_QUAL_REP.pack(ts))
+        elif t == T_QUAL_REP:
+            (ts,) = S_QUAL_REP.unpack_from(body)
+            self.ping_s = max(0.0, now_s() - ts / 1e6)
+        elif t == T_CHECKSUM:
+            frame, checksum = S_CHECKSUM.unpack_from(body)
+            if self.on_checksum:
+                self.on_checksum(frame, checksum)
+        # T_KEEP_ALIVE: recv timestamp update is enough
+
+    def _note_ack(self, ack: int) -> None:
+        if ack != NULL_FRAME and (
+            self.last_acked == NULL_FRAME or frame_gt(ack, self.last_acked)
+        ):
+            self.last_acked = ack
+
+    # -- periodic driving ---------------------------------------------------
+
+    def poll(self) -> None:
+        """Advance timers: sync retries, quality reports, keepalive,
+        disconnect detection."""
+        t = now_s()
+        if self.disconnected:
+            return
+        if self.state == SessionState.SYNCHRONIZING:
+            if t - self._last_sync_sent >= SYNC_RETRY_S:
+                self._last_sync_sent = t
+                self._send(T_SYNC_REQ, S_SYNC_REQ.pack(self._sync_nonce))
+            return
+        if t - self._last_quality_sent >= QUALITY_INTERVAL_S:
+            self._last_quality_sent = t
+            self._send(
+                T_QUAL_REQ,
+                S_QUAL_REQ.pack(
+                    int(t * 1e6), int(np.clip(self.local_advantage, -127, 127))
+                ),
+            )
+        if t - self._last_send >= KEEP_ALIVE_S:
+            self._send(T_KEEP_ALIVE)
+        quiet = t - self._last_recv
+        if quiet >= self.disconnect_timeout_s:
+            self.disconnected = True
+            self.events.append(Disconnected(self.addr))
+        elif quiet >= self.disconnect_notify_start_s and not self.interrupted:
+            self.interrupted = True
+            self.events.append(
+                NetworkInterrupted(
+                    self.addr, int(self.disconnect_timeout_s * 1000)
+                )
+            )
+
+    def stats(self) -> NetworkStats:
+        elapsed = max(now_s() - self._created, 1e-6)
+        return NetworkStats(
+            ping_ms=self.ping_s * 1e3,
+            send_queue_len=self.send_queue_len,
+            kbps_sent=self.bytes_sent * 8 / 1000 / elapsed,
+            local_frames_behind=-self.time_sync.local_advantage(),
+            remote_frames_behind=-self.remote_advantage,
+        )

@@ -1,0 +1,115 @@
+"""SpectatorSession — follow a host's session without playing.
+
+Receives confirmed all-player inputs streamed by the host's P2PSession and
+replays them; never predicts (the driver forces MaxPredictionWindow(0),
+/root/reference/src/schedule_systems.rs:200).  ``advance_frame`` raises
+PredictionThreshold while the next confirmed input has not arrived
+(the driver logs and skips, :129-135)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..utils.frames import NULL_FRAME
+from .events import (
+    InputStatus,
+    NetworkStats,
+    NotSynchronizedError,
+    PredictionThresholdError,
+    SessionState,
+)
+from .protocol import PeerEndpoint
+from .requests import AdvanceRequest
+
+
+class SpectatorSession:
+    is_spectator = True
+
+    def __init__(
+        self,
+        num_players: int,
+        host_addr: Any,
+        socket,
+        input_shape=(),
+        input_dtype=np.uint8,
+        disconnect_timeout_s: float = 2.0,
+        disconnect_notify_start_s: float = 0.5,
+        catchup_speed: int = 1,
+    ):
+        self._num_players = num_players
+        self.host_addr = host_addr
+        self.socket = socket
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.input_size = int(np.prod(self.input_shape, dtype=int) or 1) * self.input_dtype.itemsize
+        self.current_frame = 0
+        self.catchup_speed = catchup_speed
+        self.events_buf: List = []
+        self._inputs: Dict[int, np.ndarray] = {}  # frame -> [P, *shape]
+        self.endpoint = PeerEndpoint(
+            send=lambda data: self.socket.send_to(data, host_addr),
+            input_size=self.input_size * num_players,
+            rng_nonce=random.getrandbits(32),
+            disconnect_timeout_s=disconnect_timeout_s,
+            disconnect_notify_start_s=disconnect_notify_start_s,
+            addr=host_addr,
+        )
+        self.endpoint.on_input = self._on_input
+
+    def _on_input(self, frame: int, raw: bytes) -> None:
+        self._inputs[frame] = np.frombuffer(raw, self.input_dtype).reshape(
+            (self._num_players, *self.input_shape)
+        )
+
+    # -- GGRS session surface ----------------------------------------------
+
+    def num_players(self) -> int:
+        return self._num_players
+
+    def max_prediction(self) -> int:
+        return 0  # spectators never predict (schedule_systems.rs:200)
+
+    def confirmed_frame(self) -> int:
+        return self.current_frame - 1
+
+    def current_state(self) -> SessionState:
+        return (
+            SessionState.RUNNING
+            if self.endpoint.state == SessionState.RUNNING
+            else SessionState.SYNCHRONIZING
+        )
+
+    def frames_behind_host(self) -> int:
+        last = self.endpoint.last_received_frame
+        return 0 if last == NULL_FRAME else max(0, last - self.current_frame)
+
+    def events(self):
+        out = list(self.endpoint.events)
+        self.endpoint.events.clear()
+        out += self.events_buf
+        self.events_buf = []
+        return out
+
+    def network_stats(self, handle: int = 0) -> NetworkStats:
+        return self.endpoint.stats()
+
+    def poll_remote_clients(self) -> None:
+        for addr, data in self.socket.receive_all():
+            if addr == self.host_addr:
+                self.endpoint.handle(data)
+        self.endpoint.poll()
+        if self.endpoint.state == SessionState.RUNNING:
+            self.endpoint.send_input_ack()
+
+    def advance_frame(self) -> List:
+        if self.current_state() != SessionState.RUNNING:
+            raise NotSynchronizedError()
+        if self.current_frame not in self._inputs:
+            raise PredictionThresholdError()  # waiting for host input
+        inputs = self._inputs.pop(self.current_frame)
+        status = np.full((self._num_players,), InputStatus.CONFIRMED, np.int8)
+        self.current_frame += 1
+        return [AdvanceRequest(np.asarray(inputs), status)]

@@ -1,0 +1,38 @@
+"""Frame-advantage time synchronisation.
+
+Drives the run-slow flow control: each peer tracks how many frames it is
+ahead of each remote (local advantage) and learns the remote's view from
+quality reports; ``frames_ahead`` is the smoothed half-difference.  The
+driver slows the frame period by x11/10 while positive
+(/root/reference/src/schedule_systems.rs:34-38,65)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque
+
+WINDOW = 40  # frames of smoothing
+
+
+class TimeSync:
+    def __init__(self):
+        self.local_adv: Deque[int] = deque(maxlen=WINDOW)
+        self.remote_adv: Deque[int] = deque(maxlen=WINDOW)
+
+    def note_local(self, local_frame: int, remote_last_frame: int) -> None:
+        self.local_adv.append(local_frame - remote_last_frame)
+
+    def note_remote(self, remote_advantage: int) -> None:
+        self.remote_adv.append(remote_advantage)
+
+    def local_advantage(self) -> int:
+        if not self.local_adv:
+            return 0
+        return round(sum(self.local_adv) / len(self.local_adv))
+
+    def frames_ahead(self) -> int:
+        if not self.local_adv or not self.remote_adv:
+            return 0
+        l = sum(self.local_adv) / len(self.local_adv)
+        r = sum(self.remote_adv) / len(self.remote_adv)
+        return round((l - r) / 2)

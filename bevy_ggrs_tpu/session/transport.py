@@ -1,0 +1,54 @@
+"""Pluggable non-blocking transport.
+
+Mirrors the reference's socket abstraction: a ``NonBlockingSocket`` trait
+with a UDP implementation (``UdpNonBlockingSocket::bind_to_port``,
+/root/reference/tests/p2p.rs:107) and room for alternatives (the reference
+supports matchbox WebRTC; here any object with the same two methods works —
+e.g. an in-process channel for deterministic tests)."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, List, Protocol, Tuple
+
+
+class NonBlockingSocket(Protocol):
+    def send_to(self, data: bytes, addr: Any) -> None: ...
+
+    def receive_all(self) -> List[Tuple[Any, bytes]]: ...
+
+
+class UdpNonBlockingSocket:
+    """Non-blocking UDP socket bound to a local port."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0"):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setblocking(False)
+        self._sock.bind((host, port))
+
+    @classmethod
+    def bind_to_port(cls, port: int) -> "UdpNonBlockingSocket":
+        return cls(port)
+
+    @property
+    def local_addr(self) -> Tuple[str, int]:
+        return self._sock.getsockname()
+
+    def send_to(self, data: bytes, addr) -> None:
+        try:
+            self._sock.sendto(data, addr)
+        except (BlockingIOError, OSError):
+            pass  # non-blocking: drop on full buffer (UDP semantics)
+
+    def receive_all(self) -> List[Tuple[Any, bytes]]:
+        out = []
+        while True:
+            try:
+                data, addr = self._sock.recvfrom(65536)
+            except (BlockingIOError, OSError):
+                break
+            out.append((addr, data))
+        return out
+
+    def close(self) -> None:
+        self._sock.close()

@@ -31,10 +31,15 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from .world import Registry, WorldState, active_mask
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# numpy scalars, NOT jnp: pre-existing device arrays captured by a jitted
+# function are passed as per-call parameter buffers (a measured ~4 ms/call
+# slow path through the TPU tunnel); numpy scalars embed as XLA literals.
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 _SEED_HI = 0x9E3779B9
 _SEED_LO = 0x85EBCA6B
 
@@ -50,15 +55,15 @@ def mix32(h, k):
     k = k * _C2
     h = h ^ k
     h = _rotl(h, 13)
-    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    return h * np.uint32(5) + np.uint32(0xE6546B64)
 
 
 def fmix32(h):
     """murmur3 finalizer — avalanche."""
     h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x85EBCA6B)
+    h = h * np.uint32(0x85EBCA6B)
     h = h ^ (h >> 13)
-    h = h * jnp.uint32(0xC2B2AE35)
+    h = h * np.uint32(0xC2B2AE35)
     return h ^ (h >> 16)
 
 
@@ -87,18 +92,18 @@ def to_u32_lanes(arr: jnp.ndarray) -> jnp.ndarray:
     return flat.astype(jnp.uint32)
 
 
-def _type_tag(name: str, seed: int) -> jnp.uint32:
+def _type_tag(name: str, seed: int) -> np.uint32:
     """Host-side stable tag per registered type name (FNV-1a over utf-8)."""
     h = 0x811C9DC5 ^ (seed & 0xFFFFFFFF)
     for b in name.encode():
         h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
-    return jnp.uint32(h)
+    return np.uint32(h)
 
 
 def _fold_rows(lanes: jnp.ndarray, seed: jnp.uint32) -> jnp.ndarray:
     """Hash each row of ``[N, L]`` lanes -> uint32[N]."""
     n, l = lanes.shape
-    h = jnp.full((n,), seed, jnp.uint32)
+    h = jnp.full((n,), seed, jnp.uint32)  # created during trace: embeds as literal
     for i in range(l):  # L is static and small
         h = mix32(h, lanes[:, i])
     return fmix32(h ^ jnp.uint32(l))

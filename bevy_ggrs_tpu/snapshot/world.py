@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .strategy import Strategy, CopyStrategy
 
@@ -129,10 +130,14 @@ class Registry:
     ) -> "Registry":
         if name in self.components:
             raise ValueError(f"component {name!r} already registered")
+        # defaults live as NUMPY values: registry-held device arrays captured
+        # inside jitted spawn ops become per-call parameter buffers (measured
+        # slow path on the TPU tunnel); numpy embeds as XLA literals
+        np_dtype = np.dtype(jnp.dtype(dtype).name) if not isinstance(dtype, np.dtype) else dtype
         if default is None:
-            default = jnp.zeros(shape, dtype)
+            default = np.zeros(shape, np_dtype)
         else:
-            default = jnp.asarray(default, dtype)
+            default = np.asarray(default, np_dtype)
             if default.shape != tuple(shape):
                 raise ValueError(
                     f"default for {name!r} has shape {default.shape}, want {shape}"
@@ -150,7 +155,7 @@ class Registry:
         rollback (cf. /root/reference/src/snapshot/childof_snapshot.rs, whose
         inline remap exists only because host-ECS ids are unstable)."""
         return self.register_component(
-            self.PARENT, (), jnp.int32, default=jnp.int32(-1), checksum=True
+            self.PARENT, (), jnp.int32, default=np.int32(-1), checksum=True
         )
 
     @property
@@ -168,7 +173,7 @@ class Registry:
     ) -> "Registry":
         if name in self.resources:
             raise ValueError(f"resource {name!r} already registered")
-        init = jax.tree.map(jnp.asarray, init)
+        init = jax.tree.map(np.asarray, init)  # numpy: see register_component
         self.resources[name] = ResourceSpec(
             name, init, checksum, hash_fn, present, strategy
         )
@@ -183,7 +188,7 @@ class Registry:
             for n, s in self.components.items()
         }
         has = {n: jnp.zeros((cap,), bool) for n in self.components}
-        res = {n: s.init for n, s in self.resources.items()}
+        res = {n: jax.tree.map(jnp.asarray, s.init) for n, s in self.resources.items()}
         res_present = {
             n: jnp.asarray(s.present, bool) for n, s in self.resources.items()
         }

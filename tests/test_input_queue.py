@@ -1,0 +1,66 @@
+"""Input queue unit tests: delay, PredictRepeatLast, first-incorrect
+detection, redundancy dedup, gap prediction."""
+
+import numpy as np
+
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.session.events import InputStatus
+from bevy_ggrs_tpu.utils.frames import NULL_FRAME
+
+
+def test_local_delay():
+    q = InputQueue(delay=3)
+    eff = q.add_local(0, 7)
+    assert eff == 3
+    v, st = q.input_for(3)
+    assert int(v) == 7 and st == InputStatus.CONFIRMED
+    # frames before the delayed input predict default (0)
+    v, st = q.input_for(1)
+    assert int(v) == 0 and st == InputStatus.PREDICTED
+
+
+def test_predict_repeat_last():
+    q = InputQueue()
+    q.add_remote(0, 5)
+    v, st = q.input_for(4)
+    assert int(v) == 5 and st == InputStatus.PREDICTED
+
+
+def test_first_incorrect_detection():
+    q = InputQueue()
+    q.add_remote(0, 5)
+    # serve predictions for frames 1..3 (all predict 5)
+    for f in (1, 2, 3):
+        q.input_for(f)
+    q.add_remote(1, 5)  # matches prediction -> no misprediction
+    assert q.first_incorrect == NULL_FRAME
+    q.add_remote(2, 9)  # differs -> first incorrect = 2
+    q.add_remote(3, 9)  # also differs, but 2 stays first
+    assert q.first_incorrect == 2
+    assert q.take_first_incorrect() == 2
+    assert q.first_incorrect == NULL_FRAME
+
+
+def test_duplicate_and_old_inputs_ignored():
+    q = InputQueue()
+    q.add_remote(5, 1)
+    q.add_remote(3, 9)  # stale redundancy, ignored
+    assert q.last_confirmed == 5
+    assert q.confirmed_input(3) is None
+
+
+def test_inputs_since_for_redundant_packets():
+    q = InputQueue()
+    for f in range(4):
+        q.add_remote(f, f * 10)
+    got = q.inputs_since(1)
+    assert [f for f, _ in got] == [2, 3]
+
+
+def test_gc():
+    q = InputQueue()
+    for f in range(10):
+        q.add_remote(f, f)
+    q.gc(7)
+    assert q.confirmed_input(6) is None
+    assert q.confirmed_input(7) is not None

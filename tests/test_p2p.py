@@ -1,0 +1,225 @@
+"""Real-network multi-node-on-one-host tests: full apps with real loopback
+UDP sockets in one process, interleaved updates — the reference's
+tests/p2p.rs harness pattern (SURVEY §4.4).  Asserts the remote player's
+input visibly moves their entity on the other peer, confirmed frames
+advance, snapshots prune, peers stay checksum-identical, and the
+P2P+spectator trio works."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    DesyncDetection,
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    UdpNonBlockingSocket,
+)
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.events import DesyncDetected, Synchronized
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+
+
+def make_pair(input_delay=2, desync=DesyncDetection.OFF, max_prediction=8):
+    """Two box_game apps + P2P sessions over loopback UDP (ephemeral ports)."""
+    socks = [UdpNonBlockingSocket(0, host="127.0.0.1") for _ in range(2)]
+    addrs = [("127.0.0.1", s.local_addr[1]) for s in socks]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(input_delay)
+            .with_max_prediction_window(max_prediction)
+            .with_desync_detection_mode(desync)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, addrs[1 - i])
+        )
+        session = b.start_p2p_session(socks[i])
+
+        def read_inputs(handles, i=i):
+            # each peer holds a distinct direction
+            key = {0: "right", 1: "up"}[i]
+            return {h: box_game.keys_to_input(**{key: True}) for h in handles}
+
+        runners.append(GgrsRunner(app, session, read_inputs=read_inputs))
+    return runners, socks
+
+
+def interleave(runners, ticks, dt=DT, sleep=0.0):
+    for _ in range(ticks):
+        for r in runners:
+            r.update(dt)
+        if sleep:
+            time.sleep(sleep)
+
+
+def test_p2p_smoke_remote_input_moves_entity():
+    runners, socks = make_pair()
+    # sync phase: updates with zero accumulated sim time still poll
+    for _ in range(200):
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    assert all(r.session.current_state() == SessionState.RUNNING for r in runners)
+    assert any(isinstance(e, Synchronized) for r in runners for e in r.events)
+
+    x0 = [float(r.world.comps["pos"][0, 0]) for r in runners]
+    y0 = [float(r.world.comps["pos"][1, 1]) for r in runners]
+    interleave(runners, 60)
+    # player 0 (local on runner 0) held RIGHT: moved on BOTH peers
+    assert float(runners[0].world.comps["pos"][0, 0]) > x0[0]
+    assert float(runners[1].world.comps["pos"][0, 0]) > x0[1]
+    # player 1 held UP (negative z in our model: up bit -> acc -z... check moved)
+    assert float(runners[0].world.comps["pos"][1, 1]) != y0[0]
+    assert float(runners[1].world.comps["pos"][1, 1]) != y0[1]
+    assert runners[0].frame >= 50 and runners[1].frame >= 50
+    for s in socks:
+        s.close()
+
+
+def test_p2p_confirmed_advances_and_snapshots_pruned():
+    runners, socks = make_pair()
+    for _ in range(200):
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    interleave(runners, 80)
+    for r in runners:
+        assert r.session.confirmed_frame() > 40
+        assert len(r.ring) <= r.ring.depth
+        assert all(f >= r.confirmed for f in r.ring.frames())
+    for s in socks:
+        s.close()
+
+
+def test_p2p_peers_agree_on_confirmed_checksums():
+    runners, socks = make_pair()
+    for _ in range(200):
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    interleave(runners, 80)
+    common = min(r.session.confirmed_frame() for r in runners)
+    entries = [r.ring.peek(common) for r in runners]
+    assert all(e is not None for e in entries), f"frame {common} missing from a ring"
+    cs = [checksum_to_int(e[1]) for e in entries]
+    assert cs[0] == cs[1]
+    for s in socks:
+        s.close()
+
+
+def test_p2p_desync_detection_fires_on_divergence():
+    import dataclasses
+
+    runners, socks = make_pair(desync=DesyncDetection.on(5))
+    for _ in range(200):
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    interleave(runners, 30)
+    # corrupt checksummed state on peer 1 behind the session's back
+    w = runners[1].world
+    runners[1].world = dataclasses.replace(
+        w, comps={**w.comps, "pos": w.comps["pos"] + 5.0}
+    )
+    runners[1]._world_checksum = runners[1].app.checksum_fn(runners[1].world)
+    interleave(runners, 80, sleep=0.001)
+    desyncs = [
+        e for r in runners for e in r.events if isinstance(e, DesyncDetected)
+    ]
+    assert desyncs, "expected DesyncDetected after state divergence"
+    for s in socks:
+        s.close()
+
+
+def test_p2p_stalls_without_remote():
+    # peer 1 never runs -> peer 0 must stall at the prediction threshold
+    socks = [UdpNonBlockingSocket(0, host="127.0.0.1") for _ in range(2)]
+    addrs = [("127.0.0.1", s.local_addr[1]) for s in socks]
+    app = box_game.make_app(num_players=2)
+    b = (
+        SessionBuilder.for_app(app)
+        .with_max_prediction_window(4)
+        .add_player(PlayerType.LOCAL, 0)
+        .add_player(PlayerType.REMOTE, 1, addrs[1])
+    )
+    session = b.start_p2p_session(socks[0])
+    runner = GgrsRunner(app, session)
+    # complete the sync handshake manually from the silent peer's socket
+    from bevy_ggrs_tpu.session.protocol import HDR, MAGIC, S_SYNC_REP, S_SYNC_REQ, T_SYNC_REQ, T_SYNC_REP
+
+    for _ in range(100):
+        runner.update(0.0)
+        for addr, data in socks[1].receive_all():
+            magic, t = HDR.unpack_from(data)
+            if t == T_SYNC_REQ:
+                (nonce,) = S_SYNC_REQ.unpack_from(data[HDR.size:])
+                socks[1].send_to(HDR.pack(MAGIC, T_SYNC_REP) + S_SYNC_REP.pack(nonce), addr)
+        if session.current_state() == SessionState.RUNNING:
+            break
+        time.sleep(0.001)
+    assert session.current_state() == SessionState.RUNNING
+    interleave([runner], 30)
+    # advanced to the prediction limit then stalled
+    assert runner.frame <= 5
+    assert runner.stalled_frames > 0
+    for s in socks:
+        s.close()
+
+
+def test_p2p_spectator_trio():
+    socks = [UdpNonBlockingSocket(0, host="127.0.0.1") for _ in range(3)]
+    addrs = [("127.0.0.1", s.local_addr[1]) for s in socks]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, addrs[1 - i])
+        )
+        if i == 0:  # host streams to the spectator
+            b.add_player(PlayerType.SPECTATOR, 2, addrs[2])
+        session = b.start_p2p_session(socks[i])
+
+        def read_inputs(handles, i=i):
+            return {h: box_game.keys_to_input(right=(i == 0)) for h in handles}
+
+        runners.append(GgrsRunner(app, session, read_inputs=read_inputs))
+
+    spec_app = box_game.make_app(num_players=2)
+    spec_session = SessionBuilder.for_app(spec_app).start_spectator_session(
+        addrs[0], socks[2]
+    )
+    spec_runner = GgrsRunner(spec_app, spec_session)
+    everyone = runners + [spec_runner]
+
+    for _ in range(300):
+        for r in everyone:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in everyone):
+            break
+        time.sleep(0.001)
+    assert spec_session.current_state() == SessionState.RUNNING
+    interleave(everyone, 100)
+    assert spec_runner.frame > 20
+    # spectator replays the same world: player 0 moved right
+    assert float(spec_runner.world.comps["pos"][0, 0]) > 1.9
+    for s in socks:
+        s.close()
