@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""particles stress CLI — port of
+/root/reference/examples/stress_tests/particles.rs: P2P (or synctest)
+session spawning --rate particles/frame with rollback-able seeded RNG and
+full-state checksums; desync panic/continue flags."""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu import (
+    DesyncDetection,
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    UdpNonBlockingSocket,
+)
+from bevy_ggrs_tpu.models import particles
+from bevy_ggrs_tpu.snapshot import active_count
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rate", type=int, default=100, help="particles per frame")
+    ap.add_argument("--ttl", type=int, default=120)
+    ap.add_argument("--synctest", action="store_true")
+    ap.add_argument("--check-distance", type=int, default=7)
+    ap.add_argument("--local-port", type=int, default=0)
+    ap.add_argument("--players", nargs="*", default=["local", "local"])
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--continue-after-desync", action="store_true")
+    args = ap.parse_args()
+
+    app = particles.make_app(rate=args.rate, ttl=args.ttl,
+                             num_players=max(len(args.players), 1))
+    b = SessionBuilder.for_app(app).with_num_players(app.num_players)
+
+    def on_event(e):
+        print(f"event: {e}")
+        from bevy_ggrs_tpu.session.events import DesyncDetected
+
+        if isinstance(e, DesyncDetected) and not args.continue_after_desync:
+            raise SystemExit(f"DESYNC: {e}")
+
+    if args.synctest or all(p == "local" for p in args.players):
+        session = b.with_check_distance(args.check_distance).start_synctest_session()
+        runner = GgrsRunner(app, session,
+                            on_mismatch=lambda e: on_event(e))
+    else:
+        sock = UdpNonBlockingSocket(args.local_port)
+        b.with_desync_detection_mode(DesyncDetection.on(10)).with_input_delay(2)
+        for handle, spec in enumerate(args.players):
+            if spec == "local":
+                b.add_player(PlayerType.LOCAL, handle)
+            else:
+                host, port = spec.rsplit(":", 1)
+                b.add_player(PlayerType.REMOTE, handle, (host, int(port)))
+        session = b.start_p2p_session(sock)
+        runner = GgrsRunner(app, session, on_event=on_event)
+
+    t0 = time.perf_counter()
+    last = t0
+    for _ in range(args.frames):
+        now = time.perf_counter()
+        runner.update(max(now - last, 1.0 / app.fps))
+        last = now
+    dt = time.perf_counter() - t0
+    n = int(active_count(runner.world))
+    print(f"{runner.frame} frames, {n} live particles, {dt:.2f}s "
+          f"({runner.frame / dt:.0f} fps incl. resim)")
+
+
+if __name__ == "__main__":
+    main()
