@@ -25,6 +25,7 @@ from .transport import UdpNonBlockingSocket, NonBlockingSocket
 from .p2p import P2PSession
 from .spectator import SpectatorSession
 from .builder import SessionBuilder
+from .native import NativeP2PSession, native_available
 
 __all__ = [
     "InputStatus",
@@ -57,4 +58,6 @@ __all__ = [
     "P2PSession",
     "SpectatorSession",
     "SessionBuilder",
+    "NativeP2PSession",
+    "native_available",
 ]

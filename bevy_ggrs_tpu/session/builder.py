@@ -94,6 +94,29 @@ class SessionBuilder:
             disconnect_notify_start_s=self._disconnect_notify_start_s,
         )
 
+    def start_p2p_session_native(self, local_port: int = 0):
+        """P2P session backed by the native C++ host runtime
+        (native/ggrs_core) — same wire protocol, same request stream."""
+        from .native import NativeP2PSession
+
+        handles = {p.handle for p in self._players if p.kind != PlayerType.SPECTATOR}
+        if handles != set(range(self._num_players)):
+            raise InvalidRequestError(
+                f"players incomplete: have handles {sorted(handles)}"
+            )
+        return NativeP2PSession(
+            num_players=self._num_players,
+            players=self._players,
+            local_port=local_port,
+            input_shape=self.input_shape,
+            input_dtype=self.input_dtype,
+            max_prediction=self._max_prediction,
+            input_delay=self._input_delay,
+            desync_detection=self._desync,
+            disconnect_timeout_s=self._disconnect_timeout_s,
+            disconnect_notify_start_s=self._disconnect_notify_start_s,
+        )
+
     def start_synctest_session(self) -> SyncTestSession:
         return SyncTestSession(
             num_players=self._num_players,

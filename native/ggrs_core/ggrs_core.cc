@@ -1,0 +1,733 @@
+/* ggrs_core implementation — see ggrs_core.h for the API contract and
+ * bevy_ggrs_tpu/session/protocol.py for the (shared) wire format. */
+
+#include "ggrs_core.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+/* ---- frame math (explicit i32 wraparound, matches utils/frames.py) ----- */
+using Frame = int32_t;
+constexpr Frame NULL_FRAME = -1;
+
+static inline Frame frame_diff(Frame a, Frame b) {
+  return (Frame)((uint32_t)a - (uint32_t)b);
+}
+static inline bool frame_lt(Frame a, Frame b) { return frame_diff(a, b) < 0; }
+static inline bool frame_le(Frame a, Frame b) { return frame_diff(a, b) <= 0; }
+static inline bool frame_gt(Frame a, Frame b) { return frame_diff(a, b) > 0; }
+
+static double now_s() {
+  using namespace std::chrono;
+  return duration<double>(steady_clock::now().time_since_epoch()).count();
+}
+
+/* ---- wire format (little-endian; keep in sync with protocol.py) -------- */
+constexpr uint16_t MAGIC = 0x47A7;
+constexpr uint8_t T_SYNC_REQ = 1, T_SYNC_REP = 2, T_INPUT = 3, T_INPUT_ACK = 4,
+                  T_QUAL_REQ = 5, T_QUAL_REP = 6, T_KEEP_ALIVE = 7,
+                  T_CHECKSUM = 8;
+constexpr int NUM_SYNC_ROUNDTRIPS = 5;
+constexpr double SYNC_RETRY_S = 0.06, QUALITY_INTERVAL_S = 0.2,
+                 KEEP_ALIVE_S = 0.2;
+constexpr int MAX_INPUTS_PER_PACKET = 64;
+
+struct Writer {
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u16(uint16_t v) { for (int i = 0; i < 2; i++) buf.push_back(v >> (8 * i)); }
+  void u32(uint32_t v) { for (int i = 0; i < 4; i++) buf.push_back(v >> (8 * i)); }
+  void u64(uint64_t v) { for (int i = 0; i < 8; i++) buf.push_back(v >> (8 * i)); }
+  void i32(int32_t v) { u32((uint32_t)v); }
+  void i8(int8_t v) { buf.push_back((uint8_t)v); }
+  void bytes(const uint8_t *p, size_t n) { buf.insert(buf.end(), p, p + n); }
+};
+
+struct Reader {
+  const uint8_t *p;
+  size_t n, off = 0;
+  bool ok = true;
+  Reader(const uint8_t *p_, size_t n_) : p(p_), n(n_) {}
+  bool need(size_t k) { if (off + k > n) { ok = false; return false; } return true; }
+  uint8_t u8() { if (!need(1)) return 0; return p[off++]; }
+  uint16_t u16() { if (!need(2)) return 0; uint16_t v = p[off] | p[off+1] << 8; off += 2; return v; }
+  uint32_t u32() { if (!need(4)) return 0; uint32_t v = 0; for (int i = 3; i >= 0; i--) v = (v << 8) | p[off + i]; off += 4; return v; }
+  uint64_t u64() { if (!need(8)) return 0; uint64_t v = 0; for (int i = 7; i >= 0; i--) v = (v << 8) | p[off + i]; off += 8; return v; }
+  int32_t i32() { return (int32_t)u32(); }
+  int8_t i8() { return (int8_t)u8(); }
+};
+
+/* ---- addresses --------------------------------------------------------- */
+struct Addr {
+  uint32_t ip = 0;  /* network order */
+  uint16_t port = 0; /* host order */
+  bool operator<(const Addr &o) const {
+    return ip != o.ip ? ip < o.ip : port < o.port;
+  }
+  bool operator==(const Addr &o) const { return ip == o.ip && port == o.port; }
+  std::string str() const {
+    char b[64];
+    struct in_addr a; a.s_addr = ip;
+    snprintf(b, sizeof b, "%s:%u", inet_ntoa(a), (unsigned)port);
+    return b;
+  }
+};
+
+/* ---- non-blocking UDP socket ------------------------------------------- */
+struct UdpSocket {
+  int fd = -1;
+  bool open(uint16_t port) {
+    fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) return false;
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = INADDR_ANY;
+    sa.sin_port = htons(port);
+    if (bind(fd, (sockaddr *)&sa, sizeof sa) < 0) { ::close(fd); fd = -1; return false; }
+    return true;
+  }
+  uint16_t local_port() const {
+    sockaddr_in sa{}; socklen_t len = sizeof sa;
+    getsockname(fd, (sockaddr *)&sa, &len);
+    return ntohs(sa.sin_port);
+  }
+  void send_to(const Addr &a, const uint8_t *p, size_t n) {
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = a.ip;
+    sa.sin_port = htons(a.port);
+    (void)sendto(fd, p, n, 0, (sockaddr *)&sa, sizeof sa);
+  }
+  /* returns bytes read or -1 when drained */
+  int recv_from(Addr *from, uint8_t *buf, size_t cap) {
+    sockaddr_in sa{}; socklen_t len = sizeof sa;
+    ssize_t r = recvfrom(fd, buf, cap, 0, (sockaddr *)&sa, &len);
+    if (r < 0) return -1;
+    from->ip = sa.sin_addr.s_addr;
+    from->port = ntohs(sa.sin_port);
+    return (int)r;
+  }
+  ~UdpSocket() { if (fd >= 0) ::close(fd); }
+};
+
+/* ---- time sync (matches session/time_sync.py) -------------------------- */
+struct TimeSync {
+  std::deque<int> local_adv, remote_adv;
+  static constexpr size_t WINDOW = 40;
+  void note_local(int v) { local_adv.push_back(v); if (local_adv.size() > WINDOW) local_adv.pop_front(); }
+  void note_remote(int v) { remote_adv.push_back(v); if (remote_adv.size() > WINDOW) remote_adv.pop_front(); }
+  static double avg(const std::deque<int> &d) {
+    if (d.empty()) return 0;
+    double s = 0; for (int v : d) s += v;
+    return s / d.size();
+  }
+  int local_advantage() const { return (int)(avg(local_adv) + (avg(local_adv) >= 0 ? 0.5 : -0.5)); }
+  int frames_ahead() const {
+    if (local_adv.empty() || remote_adv.empty()) return 0;
+    double d = (avg(local_adv) - avg(remote_adv)) / 2.0;
+    return (int)(d + (d >= 0 ? 0.5 : -0.5));
+  }
+};
+
+/* ---- events ------------------------------------------------------------ */
+struct Event {
+  int32_t kind;
+  int32_t a = 0;
+  uint64_t b = 0;
+  Addr addr;
+};
+
+/* ---- per-peer endpoint (matches session/protocol.py PeerEndpoint) ------ */
+struct Endpoint {
+  Addr addr;
+  UdpSocket *sock = nullptr;
+  int input_size = 1;  /* bytes per frame the PEER streams to us */
+  int state = GGRS_SYNCHRONIZING;
+  uint32_t sync_nonce = 0;
+  int sync_remaining = NUM_SYNC_ROUNDTRIPS;
+  double last_sync_sent = 0, last_recv = 0, last_send = 0, last_quality = 0;
+  double disconnect_timeout_s = 2.0, disconnect_notify_s = 0.5, created = 0;
+  bool interrupted = false, disconnected = false;
+  TimeSync time_sync;
+  Frame last_acked = NULL_FRAME;        /* newest of OUR inputs peer has */
+  Frame last_received_frame = NULL_FRAME; /* newest peer input we have */
+  int local_advantage = 0, remote_advantage = 0;
+  double ping_s = 0;
+  uint64_t bytes_sent = 0;
+  int send_queue_len = 0;
+  std::vector<Event> events;
+  /* inbound inputs + checksums, drained by the session */
+  std::vector<std::pair<Frame, std::vector<uint8_t>>> inbox;
+  std::vector<std::pair<Frame, uint64_t>> checksum_inbox;
+
+  void init(double now) { last_recv = now; created = now; }
+
+  void send(uint8_t type, const Writer &body) {
+    Writer w;
+    w.u16(MAGIC); w.u8(type);
+    w.bytes(body.buf.data(), body.buf.size());
+    bytes_sent += w.buf.size();
+    last_send = now_s();
+    sock->send_to(addr, w.buf.data(), w.buf.size());
+  }
+
+  void send_sync_request() {
+    Writer b; b.u32(sync_nonce);
+    last_sync_sent = now_s();
+    send(T_SYNC_REQ, b);
+  }
+
+  void send_inputs(const std::deque<std::pair<Frame, std::vector<uint8_t>>> &pending) {
+    /* redundant packet: every un-acked input, capped */
+    std::vector<const std::pair<Frame, std::vector<uint8_t>> *> out;
+    for (auto &p : pending)
+      if (last_acked == NULL_FRAME || frame_gt(p.first, last_acked)) out.push_back(&p);
+    if ((int)out.size() > MAX_INPUTS_PER_PACKET)
+      out.erase(out.begin(), out.end() - MAX_INPUTS_PER_PACKET);
+    send_queue_len = (int)out.size();
+    if (out.empty()) return;
+    Writer b;
+    b.i32(out.front()->first);
+    b.u16((uint16_t)out.size());
+    b.i32(last_received_frame);
+    int adv = local_advantage; if (adv > 127) adv = 127; if (adv < -127) adv = -127;
+    b.i8((int8_t)adv);
+    for (auto *p : out) b.bytes(p->second.data(), p->second.size());
+    send(T_INPUT, b);
+  }
+
+  void send_input_ack() { Writer b; b.i32(last_received_frame); send(T_INPUT_ACK, b); }
+
+  void send_checksum(Frame f, uint64_t cs) {
+    Writer b; b.i32(f); b.u64(cs); send(T_CHECKSUM, b);
+  }
+
+  void note_ack(Frame ack) {
+    if (ack != NULL_FRAME && (last_acked == NULL_FRAME || frame_gt(ack, last_acked)))
+      last_acked = ack;
+  }
+
+  void handle(const uint8_t *data, size_t n) {
+    Reader r(data, n);
+    if (r.u16() != MAGIC) return;
+    uint8_t t = r.u8();
+    last_recv = now_s();
+    if (interrupted) { interrupted = false; events.push_back({GGRS_EV_RESUMED, 0, 0, addr}); }
+    switch (t) {
+      case T_SYNC_REQ: {
+        uint32_t nonce = r.u32();
+        Writer b; b.u32(nonce); send(T_SYNC_REP, b);
+        break;
+      }
+      case T_SYNC_REP: {
+        uint32_t nonce = r.u32();
+        if (state == GGRS_SYNCHRONIZING && nonce == sync_nonce) {
+          sync_remaining--;
+          sync_nonce = (uint32_t)(sync_nonce * 6364136223846793005ULL + 1ULL);
+          events.push_back({GGRS_EV_SYNCHRONIZING,
+                            NUM_SYNC_ROUNDTRIPS - sync_remaining,
+                            (uint64_t)NUM_SYNC_ROUNDTRIPS, addr});
+          if (sync_remaining <= 0) {
+            state = GGRS_RUNNING;
+            events.push_back({GGRS_EV_SYNCHRONIZED, 0, 0, addr});
+          } else {
+            send_sync_request();
+          }
+        }
+        break;
+      }
+      case T_INPUT: {
+        Frame start = r.i32();
+        uint16_t count = r.u16();
+        Frame ack = r.i32();
+        int8_t adv = r.i8();
+        if (!r.ok) break;
+        note_ack(ack);
+        time_sync.note_remote(adv);
+        remote_advantage = adv;
+        for (int i = 0; i < count; i++) {
+          Frame f = start + i;
+          if (!r.need(input_size)) break;
+          const uint8_t *raw = r.p + r.off;
+          r.off += input_size;
+          if (last_received_frame == NULL_FRAME || frame_gt(f, last_received_frame)) {
+            last_received_frame = f;
+            inbox.emplace_back(f, std::vector<uint8_t>(raw, raw + input_size));
+          }
+        }
+        break;
+      }
+      case T_INPUT_ACK: note_ack(r.i32()); break;
+      case T_QUAL_REQ: {
+        uint64_t ts = r.u64();
+        int8_t adv = r.i8();
+        time_sync.note_remote(adv);
+        remote_advantage = adv;
+        Writer b; b.u64(ts); send(T_QUAL_REP, b);
+        break;
+      }
+      case T_QUAL_REP: {
+        uint64_t ts = r.u64();
+        double rtt = now_s() - (double)ts / 1e6;
+        if (rtt > 0) ping_s = rtt;
+        break;
+      }
+      case T_CHECKSUM: {
+        Frame f = r.i32();
+        uint64_t cs = r.u64();
+        checksum_inbox.emplace_back(f, cs);
+        break;
+      }
+      default: break; /* keepalive: recv timestamp update is enough */
+    }
+  }
+
+  void poll() {
+    double t = now_s();
+    if (disconnected) return;
+    if (state == GGRS_SYNCHRONIZING) {
+      if (t - last_sync_sent >= SYNC_RETRY_S) send_sync_request();
+      return;
+    }
+    if (t - last_quality >= QUALITY_INTERVAL_S) {
+      last_quality = t;
+      Writer b;
+      b.u64((uint64_t)(t * 1e6));
+      int adv = local_advantage; if (adv > 127) adv = 127; if (adv < -127) adv = -127;
+      b.i8((int8_t)adv);
+      send(T_QUAL_REQ, b);
+    }
+    if (t - last_send >= KEEP_ALIVE_S) { Writer b; send(T_KEEP_ALIVE, b); }
+    double quiet = t - last_recv;
+    if (quiet >= disconnect_timeout_s) {
+      disconnected = true;
+      events.push_back({GGRS_EV_DISCONNECTED, 0, 0, addr});
+    } else if (quiet >= disconnect_notify_s && !interrupted) {
+      interrupted = true;
+      events.push_back({GGRS_EV_INTERRUPTED,
+                        (int32_t)(disconnect_timeout_s * 1000), 0, addr});
+    }
+  }
+};
+
+/* ---- input queue (matches session/input_queue.py) ---------------------- */
+struct InputQueue {
+  int input_size = 1, delay = 0;
+  std::map<Frame, std::vector<uint8_t>, bool (*)(Frame, Frame)> inputs{frame_lt};
+  std::map<Frame, std::vector<uint8_t>, bool (*)(Frame, Frame)> predictions{frame_lt};
+  Frame last_confirmed = NULL_FRAME;
+  Frame first_incorrect = NULL_FRAME;
+
+  std::vector<uint8_t> def() const { return std::vector<uint8_t>(input_size, 0); }
+
+  Frame add_local(Frame frame, const uint8_t *v) {
+    Frame eff = frame + delay;
+    store(eff, v);
+    return eff;
+  }
+  void add_remote(Frame frame, const uint8_t *v) { store(frame, v); }
+
+  void store(Frame frame, const uint8_t *v) {
+    if (last_confirmed != NULL_FRAME && frame_le(frame, last_confirmed)) return;
+    std::vector<uint8_t> val(v, v + input_size);
+    auto it = predictions.find(frame);
+    if (it != predictions.end()) {
+      if (it->second != val &&
+          (first_incorrect == NULL_FRAME || frame_lt(frame, first_incorrect)))
+        first_incorrect = frame;
+      predictions.erase(it);
+    }
+    inputs[frame] = std::move(val);
+    last_confirmed = frame;
+  }
+
+  /* returns status */
+  int input_for(Frame frame, uint8_t *out) {
+    auto it = inputs.find(frame);
+    if (it != inputs.end()) {
+      memcpy(out, it->second.data(), input_size);
+      return GGRS_INPUT_CONFIRMED;
+    }
+    std::vector<uint8_t> pred = def();
+    if (last_confirmed != NULL_FRAME) {
+      /* PredictRepeatLast: nearest confirmed input at or before `frame`;
+       * frames before the first real input predict the DEFAULT input (must
+       * match the python queue exactly — these early predictions are never
+       * corrected, so any mismatch is a permanent cross-peer desync) */
+      auto ub = inputs.upper_bound(frame);
+      if (ub != inputs.begin()) { --ub; pred = ub->second; }
+    }
+    predictions[frame] = pred;
+    memcpy(out, pred.data(), input_size);
+    return GGRS_INPUT_PREDICTED;
+  }
+
+  const std::vector<uint8_t> *confirmed(Frame f) const {
+    auto it = inputs.find(f);
+    return it == inputs.end() ? nullptr : &it->second;
+  }
+
+  Frame take_first_incorrect() {
+    Frame f = first_incorrect;
+    first_incorrect = NULL_FRAME;
+    return f;
+  }
+
+  void gc(Frame before) {
+    for (auto *m : {&inputs, &predictions})
+      for (auto it = m->begin(); it != m->end();)
+        it = frame_lt(it->first, before) ? m->erase(it) : std::next(it);
+  }
+};
+
+}  // namespace
+
+/* ---- the P2P session ---------------------------------------------------- */
+struct GgrsP2P {
+  int num_players = 2, input_size = 1;
+  int max_prediction = 8, input_delay = 0, desync_interval = 0;
+  double disconnect_timeout_s = 2.0, disconnect_notify_s = 0.5;
+  UdpSocket sock;
+  bool started = false;
+  Frame current_frame = 0, confirmed = NULL_FRAME;
+  std::vector<int> local_handles;
+  std::map<int, Addr> remote_handle_addr;
+  std::map<Addr, std::unique_ptr<Endpoint>> endpoints;
+  std::map<Addr, std::vector<int>> handles_of_addr;
+  std::vector<InputQueue> queues;
+  std::map<int, std::vector<uint8_t>> staged;
+  std::deque<std::pair<Frame, std::vector<uint8_t>>> local_sent;
+  std::deque<Event> events;
+  std::map<Frame, uint64_t, bool (*)(Frame, Frame)> local_checksums{frame_lt};
+  /* remote reports that arrived before our local checksum for that frame */
+  std::map<Frame, std::vector<std::pair<Addr, uint64_t>>, bool (*)(Frame, Frame)>
+      remote_checksums{frame_lt};
+  std::mt19937 rng{std::random_device{}()};
+};
+
+extern "C" {
+
+GgrsP2P *ggrs_p2p_create(int num_players, int input_size, uint16_t local_port,
+                         int max_prediction, int input_delay,
+                         int desync_interval, double disconnect_timeout_s,
+                         double disconnect_notify_s) {
+  auto *s = new GgrsP2P();
+  s->num_players = num_players;
+  s->input_size = input_size;
+  s->max_prediction = max_prediction;
+  s->input_delay = input_delay;
+  s->desync_interval = desync_interval;
+  s->disconnect_timeout_s = disconnect_timeout_s;
+  s->disconnect_notify_s = disconnect_notify_s;
+  if (!s->sock.open(local_port)) { delete s; return nullptr; }
+  s->queues.resize(num_players);
+  for (auto &q : s->queues) q.input_size = input_size;
+  return s;
+}
+
+uint16_t ggrs_p2p_local_port(GgrsP2P *s) { return s->sock.local_port(); }
+
+int ggrs_p2p_add_player(GgrsP2P *s, int kind, int handle, const char *ip,
+                        uint16_t port) {
+  if (kind == GGRS_LOCAL) {
+    if (handle < 0 || handle >= s->num_players) return GGRS_ERR_INVALID_REQUEST;
+    s->local_handles.push_back(handle);
+    s->queues[handle].delay = s->input_delay;
+    return GGRS_OK;
+  }
+  Addr a;
+  a.ip = inet_addr(ip ? ip : "127.0.0.1");
+  a.port = port;
+  if (kind == GGRS_REMOTE) {
+    if (handle < 0 || handle >= s->num_players) return GGRS_ERR_INVALID_REQUEST;
+    s->remote_handle_addr[handle] = a;
+    s->handles_of_addr[a].push_back(handle);
+    return GGRS_OK;
+  }
+  return GGRS_ERR_INVALID_REQUEST; /* spectators: python layer for now */
+}
+
+int ggrs_p2p_start(GgrsP2P *s) {
+  size_t have = s->local_handles.size() + s->remote_handle_addr.size();
+  if ((int)have != s->num_players) return GGRS_ERR_INVALID_REQUEST;
+  double t = now_s();
+  for (auto &[addr, handles] : s->handles_of_addr) {
+    auto ep = std::make_unique<Endpoint>();
+    ep->addr = addr;
+    ep->sock = &s->sock;
+    ep->input_size = s->input_size * (int)handles.size();
+    ep->sync_nonce = s->rng();
+    ep->disconnect_timeout_s = s->disconnect_timeout_s;
+    ep->disconnect_notify_s = s->disconnect_notify_s;
+    ep->init(t);
+    s->endpoints[addr] = std::move(ep);
+  }
+  s->started = true;
+  return GGRS_OK;
+}
+
+void ggrs_p2p_destroy(GgrsP2P *s) { delete s; }
+
+int ggrs_p2p_state(GgrsP2P *s) {
+  for (auto &[a, ep] : s->endpoints)
+    if (ep->state != GGRS_RUNNING && !ep->disconnected) return GGRS_SYNCHRONIZING;
+  return GGRS_RUNNING;
+}
+
+void ggrs_p2p_poll(GgrsP2P *s) {
+  uint8_t buf[65536];
+  Addr from;
+  int n;
+  while ((n = s->sock.recv_from(&from, buf, sizeof buf)) >= 0) {
+    auto it = s->endpoints.find(from);
+    if (it != s->endpoints.end()) it->second->handle(buf, (size_t)n);
+  }
+  for (auto &[addr, ep] : s->endpoints) {
+    if (ep->last_received_frame != NULL_FRAME) {
+      int adv = frame_diff(s->current_frame, ep->last_received_frame);
+      ep->local_advantage = adv;
+      ep->time_sync.note_local(adv);
+    }
+    ep->poll();
+    /* drain endpoint state into the session */
+    for (auto &e : ep->events) s->events.push_back(e);
+    ep->events.clear();
+    for (auto &[f, raw] : ep->inbox) {
+      auto &handles = s->handles_of_addr[addr];
+      for (size_t i = 0; i < handles.size(); i++)
+        s->queues[handles[i]].add_remote(f, raw.data() + i * s->input_size);
+    }
+    ep->inbox.clear();
+    /* desync compare (or park until our local checksum exists) */
+    for (auto &[f, remote_cs] : ep->checksum_inbox) {
+      auto it = s->local_checksums.find(f);
+      if (it == s->local_checksums.end())
+        s->remote_checksums[f].emplace_back(addr, remote_cs);
+      else if (it->second != remote_cs)
+        s->events.push_back({GGRS_EV_DESYNC, f, remote_cs, addr});
+    }
+    ep->checksum_inbox.clear();
+    if (ep->state == GGRS_RUNNING && !ep->disconnected)
+      ep->send_inputs(s->local_sent);
+  }
+}
+
+int ggrs_p2p_add_local_input(GgrsP2P *s, int handle, const uint8_t *data) {
+  bool is_local = false;
+  for (int h : s->local_handles) is_local |= (h == handle);
+  if (!is_local) return GGRS_ERR_INVALID_REQUEST;
+  if (ggrs_p2p_state(s) != GGRS_RUNNING) return GGRS_ERR_NOT_SYNCHRONIZED;
+  s->staged[handle] = std::vector<uint8_t>(data, data + s->input_size);
+  return GGRS_OK;
+}
+
+static Frame compute_confirmed(GgrsP2P *s) {
+  Frame c = s->current_frame;
+  for (auto &[h, addr] : s->remote_handle_addr) {
+    auto &ep = s->endpoints[addr];
+    if (ep->disconnected) continue;
+    Frame lc = s->queues[h].last_confirmed;
+    if (lc == NULL_FRAME || frame_lt(lc, c)) c = lc;
+    if (c == NULL_FRAME) break;
+  }
+  return c;
+}
+
+int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
+                     uint8_t *input_buf, int input_cap, int *n_req_words,
+                     int *n_input_bytes) {
+  *n_req_words = 0;
+  *n_input_bytes = 0;
+  if (ggrs_p2p_state(s) != GGRS_RUNNING) return GGRS_ERR_NOT_SYNCHRONIZED;
+  for (int h : s->local_handles)
+    if (!s->staged.count(h)) return GGRS_ERR_INVALID_REQUEST;
+
+  Frame new_confirmed = compute_confirmed(s);
+  if (frame_diff(s->current_frame, new_confirmed) > s->max_prediction) {
+    s->staged.clear();
+    return GGRS_ERR_PREDICTION_THRESHOLD;
+  }
+
+  /* commit + broadcast local inputs */
+  Frame eff = NULL_FRAME;
+  for (int h : s->local_handles)
+    eff = s->queues[h].add_local(s->current_frame, s->staged[h].data());
+  s->staged.clear();
+  if (!s->local_handles.empty()) {
+    std::vector<uint8_t> row;
+    for (int h : s->local_handles) {
+      const auto *v = s->queues[h].confirmed(eff);
+      row.insert(row.end(), v->begin(), v->end());
+    }
+    s->local_sent.emplace_back(eff, std::move(row));
+    for (auto &[a, ep] : s->endpoints)
+      if (ep->state == GGRS_RUNNING && !ep->disconnected)
+        ep->send_inputs(s->local_sent);
+  }
+
+  int rw = 0, ib = 0;
+  auto emit_save = [&](Frame f) -> bool {
+    if (rw + 2 > req_cap) return false;
+    req_buf[rw++] = GGRS_REQ_SAVE;
+    req_buf[rw++] = f;
+    return true;
+  };
+  auto emit_load = [&](Frame f) -> bool {
+    if (rw + 2 > req_cap) return false;
+    req_buf[rw++] = GGRS_REQ_LOAD;
+    req_buf[rw++] = f;
+    return true;
+  };
+  auto emit_advance = [&](Frame f) -> bool {
+    if (rw + 2 + s->num_players > req_cap) return false;
+    if (ib + s->num_players * s->input_size > input_cap) return false;
+    req_buf[rw++] = GGRS_REQ_ADVANCE;
+    req_buf[rw++] = f;
+    for (int h = 0; h < s->num_players; h++) {
+      int status;
+      auto it = s->remote_handle_addr.find(h);
+      if (it != s->remote_handle_addr.end() && s->endpoints[it->second]->disconnected) {
+        status = GGRS_INPUT_DISCONNECTED;
+        memset(input_buf + ib, 0, s->input_size);
+      } else {
+        status = s->queues[h].input_for(f, input_buf + ib);
+      }
+      req_buf[rw++] = status;
+      ib += s->input_size;
+    }
+    return true;
+  };
+
+  /* rollback on misprediction */
+  Frame first_incorrect = NULL_FRAME;
+  for (auto &q : s->queues) {
+    Frame f = q.take_first_incorrect();
+    if (f != NULL_FRAME &&
+        (first_incorrect == NULL_FRAME || frame_lt(f, first_incorrect)))
+      first_incorrect = f;
+  }
+  bool rolled_back = false;
+  if (first_incorrect != NULL_FRAME && frame_lt(first_incorrect, s->current_frame)) {
+    if (!emit_load(first_incorrect)) return GGRS_ERR_BUFFER_TOO_SMALL;
+    for (Frame i = first_incorrect; frame_lt(i, s->current_frame); i++) {
+      if (!emit_advance(i)) return GGRS_ERR_BUFFER_TOO_SMALL;
+      if (!emit_save(i + 1)) return GGRS_ERR_BUFFER_TOO_SMALL;
+    }
+    rolled_back = true;
+  }
+
+  s->confirmed = new_confirmed;
+
+  /* gc */
+  Frame horizon = s->confirmed - s->max_prediction - 2;
+  for (auto &q : s->queues) q.gc(horizon);
+  Frame acked = NULL_FRAME;
+  bool first = true;
+  for (auto &[a, ep] : s->endpoints) {
+    if (first || (ep->last_acked != NULL_FRAME &&
+                  (acked == NULL_FRAME || frame_lt(ep->last_acked, acked))))
+      acked = ep->last_acked;
+    first = false;
+  }
+  while (!s->local_sent.empty() && acked != NULL_FRAME &&
+         frame_le(s->local_sent.front().first, acked))
+    s->local_sent.pop_front();
+  for (auto it = s->local_checksums.begin(); it != s->local_checksums.end();)
+    it = frame_lt(it->first, horizon) ? s->local_checksums.erase(it) : std::next(it);
+  for (auto it = s->remote_checksums.begin(); it != s->remote_checksums.end();)
+    it = frame_lt(it->first, horizon) ? s->remote_checksums.erase(it) : std::next(it);
+
+  if (!rolled_back && !emit_save(s->current_frame))
+    return GGRS_ERR_BUFFER_TOO_SMALL;
+  if (!emit_advance(s->current_frame)) return GGRS_ERR_BUFFER_TOO_SMALL;
+  s->current_frame++;
+  *n_req_words = rw;
+  *n_input_bytes = ib;
+  return GGRS_OK;
+}
+
+int32_t ggrs_p2p_current_frame(GgrsP2P *s) { return s->current_frame; }
+int32_t ggrs_p2p_confirmed_frame(GgrsP2P *s) { return s->confirmed; }
+int ggrs_p2p_max_prediction(GgrsP2P *s) { return s->max_prediction; }
+int ggrs_p2p_num_players(GgrsP2P *s) { return s->num_players; }
+
+int ggrs_p2p_frames_ahead(GgrsP2P *s) {
+  int m = 0;
+  for (auto &[a, ep] : s->endpoints)
+    if (!ep->disconnected) {
+      int v = ep->time_sync.frames_ahead();
+      if (v > m) m = v;
+    }
+  return m;
+}
+
+int ggrs_p2p_local_handles(GgrsP2P *s, int32_t *out, int cap) {
+  int n = 0;
+  for (int h : s->local_handles)
+    if (n < cap) out[n++] = h;
+  return n;
+}
+
+int ggrs_p2p_next_event(GgrsP2P *s, int32_t *kind, int32_t *a, uint64_t *b,
+                        char *addrbuf, int addrcap) {
+  if (s->events.empty()) return 0;
+  Event e = s->events.front();
+  s->events.pop_front();
+  *kind = e.kind;
+  *a = e.a;
+  *b = e.b;
+  std::string str = e.addr.str();
+  snprintf(addrbuf, addrcap, "%s", str.c_str());
+  return 1;
+}
+
+void ggrs_p2p_push_checksum(GgrsP2P *s, int32_t frame, uint64_t checksum) {
+  if (s->desync_interval <= 0) return;
+  if (frame % s->desync_interval != 0) return;
+  s->local_checksums[frame] = checksum;
+  auto pit = s->remote_checksums.find(frame);
+  if (pit != s->remote_checksums.end()) {
+    for (auto &[addr, remote_cs] : pit->second)
+      if (remote_cs != checksum)
+        s->events.push_back({GGRS_EV_DESYNC, frame, remote_cs, addr});
+    s->remote_checksums.erase(pit);
+  }
+  for (auto &[a, ep] : s->endpoints)
+    if (ep->state == GGRS_RUNNING && !ep->disconnected)
+      ep->send_checksum(frame, checksum);
+}
+
+int ggrs_p2p_stats(GgrsP2P *s, int handle, double *ping_ms, int *send_queue,
+                   double *kbps_sent, int *local_frames_behind,
+                   int *remote_frames_behind) {
+  auto it = s->remote_handle_addr.find(handle);
+  if (it == s->remote_handle_addr.end()) return GGRS_ERR_INVALID_REQUEST;
+  auto &ep = s->endpoints[it->second];
+  double elapsed = now_s() - ep->created;
+  if (elapsed < 1e-6) elapsed = 1e-6;
+  *ping_ms = ep->ping_s * 1e3;
+  *send_queue = ep->send_queue_len;
+  *kbps_sent = (double)ep->bytes_sent * 8 / 1000 / elapsed;
+  *local_frames_behind = -ep->time_sync.local_advantage();
+  *remote_frames_behind = -ep->remote_advantage;
+  return GGRS_OK;
+}
+
+} /* extern "C" */

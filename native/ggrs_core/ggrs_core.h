@@ -1,0 +1,100 @@
+/* ggrs_core — native host runtime for bevy_ggrs_tpu.
+ *
+ * C API for the session/network core (the reference consumes this layer from
+ * the native `ggrs` crate; SURVEY.md §2.3 reconstructs the surface).  The
+ * simulation data plane stays in JAX on the TPU; this library owns the
+ * latency-sensitive host path: non-blocking UDP, the wire protocol (format
+ * shared with bevy_ggrs_tpu/session/protocol.py — the two implementations
+ * interoperate on the wire), per-peer endpoint state machines, input queues
+ * with PredictRepeatLast prediction, and the P2P advance/rollback decision.
+ *
+ * Request stream encoding returned by ggrs_p2p_advance:
+ *   int32 records, one request after another:
+ *     SAVE    -> [0, frame]
+ *     LOAD    -> [1, frame]
+ *     ADVANCE -> [2, frame, status[0..num_players-1]]
+ *   each ADVANCE additionally appends num_players*input_size bytes to the
+ *   input byte buffer, in handle order.
+ */
+
+#ifndef GGRS_CORE_H
+#define GGRS_CORE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+enum GgrsPlayerKind { GGRS_LOCAL = 0, GGRS_REMOTE = 1, GGRS_SPECTATOR = 2 };
+enum GgrsState { GGRS_SYNCHRONIZING = 0, GGRS_RUNNING = 1 };
+enum GgrsReq { GGRS_REQ_SAVE = 0, GGRS_REQ_LOAD = 1, GGRS_REQ_ADVANCE = 2 };
+enum GgrsInputStatus {
+  GGRS_INPUT_CONFIRMED = 0,
+  GGRS_INPUT_PREDICTED = 1,
+  GGRS_INPUT_DISCONNECTED = 2
+};
+enum GgrsErr {
+  GGRS_OK = 0,
+  GGRS_ERR_PREDICTION_THRESHOLD = -1,
+  GGRS_ERR_NOT_SYNCHRONIZED = -2,
+  GGRS_ERR_INVALID_REQUEST = -3,
+  GGRS_ERR_BUFFER_TOO_SMALL = -4,
+};
+enum GgrsEventKind {
+  GGRS_EV_SYNCHRONIZING = 0,
+  GGRS_EV_SYNCHRONIZED = 1,
+  GGRS_EV_DISCONNECTED = 2,
+  GGRS_EV_INTERRUPTED = 3,
+  GGRS_EV_RESUMED = 4,
+  GGRS_EV_DESYNC = 5,
+};
+
+typedef struct GgrsP2P GgrsP2P;
+
+/* lifecycle ---------------------------------------------------------------*/
+GgrsP2P *ggrs_p2p_create(int num_players, int input_size, uint16_t local_port,
+                         int max_prediction, int input_delay,
+                         int desync_interval, double disconnect_timeout_s,
+                         double disconnect_notify_s);
+int ggrs_p2p_add_player(GgrsP2P *s, int kind, int handle, const char *ip,
+                        uint16_t port);
+int ggrs_p2p_start(GgrsP2P *s); /* validate player set, begin sync */
+void ggrs_p2p_destroy(GgrsP2P *s);
+uint16_t ggrs_p2p_local_port(GgrsP2P *s);
+
+/* per-tick ----------------------------------------------------------------*/
+void ggrs_p2p_poll(GgrsP2P *s); /* poll_remote_clients */
+int ggrs_p2p_state(GgrsP2P *s);
+int ggrs_p2p_add_local_input(GgrsP2P *s, int handle, const uint8_t *data);
+int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
+                     uint8_t *input_buf, int input_cap, int *n_req_words,
+                     int *n_input_bytes);
+
+/* queries -----------------------------------------------------------------*/
+int32_t ggrs_p2p_current_frame(GgrsP2P *s);
+int32_t ggrs_p2p_confirmed_frame(GgrsP2P *s);
+int ggrs_p2p_frames_ahead(GgrsP2P *s);
+int ggrs_p2p_max_prediction(GgrsP2P *s);
+int ggrs_p2p_num_players(GgrsP2P *s);
+int ggrs_p2p_local_handles(GgrsP2P *s, int32_t *out, int cap);
+
+/* events: returns 1 if an event was popped.  a/b meaning per kind:
+ *  SYNCHRONIZING: a=count b=total; DESYNC: a=frame b=remote_checksum.
+ *  addr written as "ip:port" into addrbuf (>=64 bytes). */
+int ggrs_p2p_next_event(GgrsP2P *s, int32_t *kind, int32_t *a, uint64_t *b,
+                        char *addrbuf, int addrcap);
+
+/* desync detection: the TPU side pushes confirmed-frame checksums here */
+void ggrs_p2p_push_checksum(GgrsP2P *s, int32_t frame, uint64_t checksum);
+
+/* network stats for a remote handle */
+int ggrs_p2p_stats(GgrsP2P *s, int handle, double *ping_ms, int *send_queue,
+                   double *kbps_sent, int *local_frames_behind,
+                   int *remote_frames_behind);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* GGRS_CORE_H */

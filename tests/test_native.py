@@ -1,0 +1,196 @@
+"""Native C++ core tests: native<->native box_game over loopback UDP, and
+wire interop — a NATIVE peer playing a PYTHON peer must converge to
+identical confirmed checksums (same protocol, same prediction semantics)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    DesyncDetection,
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    UdpNonBlockingSocket,
+)
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.native import native_available
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native ggrs_core not built"
+)
+
+DT = 1.0 / 60.0
+
+
+def assert_checksums_agree(r0, r1):
+    """Align the two runners (confirmed ~ current on loopback, so rings can
+    be offset by one frame) and compare checksums for a shared frame."""
+    got = None
+    for _ in range(6):
+        shared = sorted(set(r0.ring.frames()) & set(r1.ring.frames()))
+        if shared:
+            f = shared[-1]
+            got = (
+                f,
+                checksum_to_int(r0.ring.peek(f)[1]),
+                checksum_to_int(r1.ring.peek(f)[1]),
+            )
+            break
+        behind = r0 if r0.frame <= r1.frame else r1
+        behind.update(DT)
+    assert got is not None, "rings share no frame"
+    _, c0, c1 = got
+    assert c0 == c1, f"checksum divergence at frame {got[0]}"
+
+
+def sync_all(runners, max_iters=400):
+    for _ in range(max_iters):
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            return True
+        time.sleep(0.001)
+    return False
+
+
+def interleave(runners, ticks):
+    for _ in range(ticks):
+        for r in runners:
+            r.update(DT)
+
+
+def make_native_runner(i, my_port, peer_port, input_delay=2):
+    app = box_game.make_app(num_players=2)
+    b = (
+        SessionBuilder.for_app(app)
+        .with_input_delay(input_delay)
+        .add_player(PlayerType.LOCAL, i)
+        .add_player(PlayerType.REMOTE, 1 - i, ("127.0.0.1", peer_port))
+    )
+    session = b.start_p2p_session_native(local_port=my_port)
+
+    def read_inputs(handles, i=i):
+        key = {0: "right", 1: "up"}[i]
+        return {h: box_game.keys_to_input(**{key: True}) for h in handles}
+
+    return GgrsRunner(app, session, read_inputs=read_inputs)
+
+
+def free_ports(n):
+    import socket as so
+
+    socks = [so.socket(so.AF_INET, so.SOCK_DGRAM) for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_native_vs_native_smoke():
+    p0, p1 = free_ports(2)
+    r0 = make_native_runner(0, p0, p1)
+    r1 = make_native_runner(1, p1, p0)
+    assert sync_all([r0, r1])
+    x0 = float(r1.world.comps["pos"][0, 0])
+    interleave([r0, r1], 60)
+    assert r0.frame >= 50 and r1.frame >= 50
+    # remote input visible on the other peer
+    assert float(r1.world.comps["pos"][0, 0]) > x0
+    # confirmed checksums agree
+    assert_checksums_agree(r0, r1)
+
+
+def test_native_vs_python_wire_interop():
+    p_native, p_python = free_ports(2)
+    r_native = make_native_runner(0, p_native, p_python)
+
+    app = box_game.make_app(num_players=2)
+    sock = UdpNonBlockingSocket(p_python, host="0.0.0.0")
+    b = (
+        SessionBuilder.for_app(app)
+        .with_input_delay(2)
+        .add_player(PlayerType.LOCAL, 1)
+        .add_player(PlayerType.REMOTE, 0, ("127.0.0.1", p_native))
+    )
+    session = b.start_p2p_session(sock)
+    r_python = GgrsRunner(
+        app, session,
+        read_inputs=lambda hs: {h: box_game.keys_to_input(up=True) for h in hs},
+    )
+    assert sync_all([r_native, r_python])
+    interleave([r_native, r_python], 80)
+    assert r_native.frame >= 60 and r_python.frame >= 60
+    assert min(
+        r_native.session.confirmed_frame(), r_python.session.confirmed_frame()
+    ) > 30
+    assert_checksums_agree(r_native, r_python)
+    sock.close()
+
+
+def test_native_desync_detection():
+    import dataclasses
+
+    p0, p1 = free_ports(2)
+    runners = []
+    for i, (mine, theirs) in enumerate([(p0, p1), (p1, p0)]):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_desync_detection_mode(DesyncDetection.on(5))
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, ("127.0.0.1", theirs))
+        )
+        session = b.start_p2p_session_native(local_port=mine)
+        runners.append(GgrsRunner(app, session))
+    assert sync_all(runners)
+    interleave(runners, 30)
+    w = runners[1].world
+    runners[1].world = dataclasses.replace(
+        w, comps={**w.comps, "pos": w.comps["pos"] + 3.0}
+    )
+    runners[1]._world_checksum = runners[1].app.checksum_fn(runners[1].world)
+    from bevy_ggrs_tpu.session.events import DesyncDetected
+
+    for _ in range(120):
+        interleave(runners, 5)
+        time.sleep(0.002)
+        desyncs = [
+            e for r in runners for e in r.events if isinstance(e, DesyncDetected)
+        ]
+        if desyncs:
+            break
+    assert desyncs
+
+
+def test_native_stall_without_remote():
+    p0, p1 = free_ports(2)
+    r0 = make_native_runner(0, p0, p1, input_delay=0)
+    # fake peer: reply to sync requests only, never send inputs
+    from bevy_ggrs_tpu.session.protocol import (
+        HDR, MAGIC, S_SYNC_REP, S_SYNC_REQ, T_SYNC_REQ, T_SYNC_REP,
+    )
+
+    sock = UdpNonBlockingSocket(p1, host="0.0.0.0")
+    for _ in range(200):
+        r0.update(0.0)
+        for addr, data in sock.receive_all():
+            magic, t = HDR.unpack_from(data)
+            if t == T_SYNC_REQ:
+                (nonce,) = S_SYNC_REQ.unpack_from(data[HDR.size:])
+                sock.send_to(
+                    HDR.pack(MAGIC, T_SYNC_REP) + S_SYNC_REP.pack(nonce), addr
+                )
+        if r0.session.current_state() == SessionState.RUNNING:
+            break
+        time.sleep(0.001)
+    assert r0.session.current_state() == SessionState.RUNNING
+    interleave([r0], 30)
+    assert r0.frame <= 9  # max_prediction 8 + initial frame
+    assert r0.stalled_frames > 0
+    sock.close()
