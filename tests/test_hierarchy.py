@@ -1,0 +1,118 @@
+"""Hierarchy rollback — port of /root/reference/tests/hierarchy.rs:60-182:
+3-level parent chains preserved across continuous rollback; child despawn
+rolled back cleanly; recursive despawn takes the subtree."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu import App, GgrsRunner, SyncTestSession
+from bevy_ggrs_tpu.snapshot import (
+    Registry,
+    active_mask,
+    despawn_recursive,
+    despawn_where,
+    spawn,
+)
+
+
+def make_chain_app(levels=3, chains=4, despawn_leaf_at=None, despawn_root_at=None):
+    app = App(num_players=1, capacity=32, input_shape=(), input_dtype=np.uint8)
+    app.register_hierarchy()
+    app.rollback_component("depth", (), jnp.int32, checksum=True)
+    app.rollback_component("age", (), jnp.int32, checksum=True)
+    roots = []
+
+    def step(world, ctx):
+        m = active_mask(world) & world.has["age"]
+        world = dataclasses.replace(
+            world,
+            comps={**world.comps,
+                   "age": jnp.where(m, world.comps["age"] + 1, world.comps["age"])},
+        )
+        if despawn_leaf_at is not None:
+            kill = m & (ctx.frame == despawn_leaf_at) & (
+                world.comps["depth"] == levels - 1
+            )
+            world = despawn_where(app.reg, world, kill, ctx.frame)
+        if despawn_root_at is not None:
+            world = jax.lax.cond(
+                ctx.frame == despawn_root_at,
+                lambda w: despawn_recursive(app.reg, w, roots[0], ctx.frame),
+                lambda w: w,
+                world,
+            )
+        return world
+
+    import jax
+
+    def setup(world):
+        for c in range(chains):
+            parent = -1
+            for d in range(levels):
+                world, slot = spawn(
+                    app.reg, world,
+                    {Registry.PARENT: parent, "depth": d, "age": 0},
+                )
+                if d == 0:
+                    roots.append(int(slot))
+                parent = int(slot)
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+    return app
+
+
+def run(app, ticks, check_distance=3):
+    session = SyncTestSession(num_players=1, input_shape=(),
+                              input_dtype=np.uint8, check_distance=check_distance)
+    mismatches = []
+    runner = GgrsRunner(app, session, on_mismatch=mismatches.append)
+    for _ in range(ticks):
+        runner.tick()
+    return runner, mismatches
+
+
+def test_three_level_chains_preserved():
+    app = make_chain_app()
+    runner, mismatches = run(app, 20)
+    assert mismatches == []
+    w = runner.world
+    parent = np.asarray(w.comps[Registry.PARENT])
+    depth = np.asarray(w.comps["depth"])
+    alive = np.asarray(active_mask(w))
+    for slot in range(12):
+        assert alive[slot]
+        if depth[slot] > 0:
+            p = parent[slot]
+            assert alive[p]
+            assert depth[p] == depth[slot] - 1  # chain intact
+    assert np.all(np.asarray(w.comps["age"])[:12] == 20)
+
+
+def test_child_despawn_across_rollback():
+    app = make_chain_app(despawn_leaf_at=8)
+    runner, mismatches = run(app, 20)
+    assert mismatches == []
+    w = runner.world
+    alive = np.asarray(active_mask(w))
+    depth = np.asarray(w.comps["depth"])
+    has = np.asarray(w.has["depth"])
+    # leaves gone, inner nodes alive
+    for slot in range(12):
+        if has[slot] and alive[slot]:
+            assert depth[slot] < 2
+    assert sum(alive[:12]) == 8
+
+
+def test_recursive_root_despawn_takes_subtree():
+    app = make_chain_app(despawn_root_at=6)
+    runner, mismatches = run(app, 20)
+    assert mismatches == []
+    w = runner.world
+    alive = np.asarray(active_mask(w))
+    # first chain (slots 0,1,2) fully gone, others intact
+    assert not alive[0] and not alive[1] and not alive[2]
+    assert alive[3] and alive[4] and alive[5]

@@ -1,0 +1,78 @@
+"""Deterministic time — port of /root/reference/tests/time.rs:18-49 and the
+GgrsTime semantics (src/time.rs:63-87): simulation time = frame / fps,
+identical under resimulation, restarting from zero on session restart."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu import App, GgrsRunner, SyncTestSession
+from bevy_ggrs_tpu.snapshot import active_mask, spawn
+
+
+def make_app(fps=60):
+    app = App(num_players=1, capacity=4, fps=fps, input_shape=(),
+              input_dtype=np.uint8)
+    app.rollback_component("t", (), jnp.float32, checksum=False)
+    app.rollback_component("dt_sum", (), jnp.float32, checksum=False)
+    app.rollback_component("n", (), jnp.int32, checksum=True)
+
+    def step(world, ctx):
+        m = active_mask(world)
+        return dataclasses.replace(
+            world,
+            comps={
+                "t": jnp.where(m, ctx.time_seconds, world.comps["t"]),
+                "dt_sum": jnp.where(m, world.comps["dt_sum"] + ctx.delta_seconds,
+                                    world.comps["dt_sum"]),
+                "n": jnp.where(m, world.comps["n"] + 1, world.comps["n"]),
+            },
+        )
+
+    def setup(world):
+        world, _ = spawn(app.reg, world, {})
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+    return app
+
+
+def session():
+    return SyncTestSession(num_players=1, input_shape=(), input_dtype=np.uint8,
+                           check_distance=2)
+
+
+def test_ggrs_time_is_frame_over_fps():
+    app = make_app(fps=60)
+    mismatches = []
+    runner = GgrsRunner(app, session(), on_mismatch=mismatches.append)
+    for _ in range(30):
+        runner.tick()
+    assert mismatches == []
+    assert abs(float(runner.world.comps["t"][0]) - 30 / 60) < 1e-6
+    assert abs(float(runner.world.comps["dt_sum"][0]) - 30 / 60) < 1e-4
+
+
+def test_time_restarts_with_session():
+    # session restart: time rebuilds from zero (src/time.rs:79-86 behavior)
+    app = make_app()
+    runner = GgrsRunner(app, session())
+    for _ in range(10):
+        runner.tick()
+    t_before = float(runner.world.comps["t"][0])
+    assert t_before > 0.1
+    runner.set_session(session())
+    runner.world = app.init_state()
+    runner._world_checksum = app.checksum_fn(runner.world)
+    for _ in range(3):
+        runner.tick()
+    assert abs(float(runner.world.comps["t"][0]) - 3 / 60) < 1e-6
+
+
+def test_accumulator_respects_fps():
+    app = make_app(fps=30)
+    runner = GgrsRunner(app, session())
+    runner.update(1.0)  # one second -> 30 frames at 30 fps
+    assert runner.frame == 30
