@@ -1,3 +1,3 @@
-from . import box_game, particles
+from . import box_game, particles, stress, fixed_point
 
-__all__ = ["box_game", "particles"]
+__all__ = ["box_game", "particles", "stress", "fixed_point"]

@@ -1,0 +1,91 @@
+"""fixed_point — integer-math box_game for cross-backend determinism.
+
+The reference warns that f32 math differs across platforms
+(/root/reference/docs/debugging-desyncs.md:55); mixed-platform lobbies need
+integer simulation math.  This model re-expresses the box_game ice physics
+in Q16.16 fixed point (int32 columns, shifts and integer multiplies only),
+so CPU and TPU produce bit-identical states and therefore exactly equal
+checksums — the "SyncTest checksum parity" oracle in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..app import App
+from ..ops.resim import StepCtx
+from ..snapshot.world import WorldState, active_mask, spawn
+
+FP = 16  # fractional bits
+ONE = 1 << FP
+
+ACCEL = ONE // 200  # per-frame acceleration in Q16.16
+# friction 255/256 per frame, exact in integers
+ARENA_HALF = 4 * ONE
+
+
+def _q_mul(a, b):
+    """Q16.16 multiply without int64: split b into hi/lo 16-bit halves."""
+    bh = b >> FP
+    bl = b & (ONE - 1)
+    return a * bh + ((a * bl) >> FP)
+
+
+def step(world: WorldState, ctx: StepCtx) -> WorldState:
+    handle = world.comps["handle"]
+    mask = active_mask(world) & world.has["handle"]
+    inp = ctx.inputs.reshape(-1)[jnp.clip(handle, 0, ctx.inputs.shape[0] - 1)]
+    inp = jnp.where(mask, inp, 0).astype(jnp.int32)
+
+    def bit(b):
+        return (inp >> b) & 1
+
+    acc_x = (bit(3) - bit(2)) * ACCEL
+    acc_z = (bit(1) - bit(0)) * ACCEL
+
+    vel = world.comps["vel"]
+    vel = vel + jnp.stack([acc_x, acc_z], axis=-1)
+    vel = (vel * 255) >> 8  # friction, arithmetic shift (exact, wrapping-safe)
+
+    pos = world.comps["pos"] + vel
+    pos = jnp.clip(pos, -ARENA_HALF, ARENA_HALF)
+
+    m = mask[:, None]
+    return dataclasses.replace(
+        world,
+        comps={
+            **world.comps,
+            "vel": jnp.where(m, vel, world.comps["vel"]),
+            "pos": jnp.where(m, pos, world.comps["pos"]),
+        },
+    )
+
+
+def make_app(num_players: int = 2, capacity: int = 8, fps: int = 60) -> App:
+    app = App(num_players=num_players, capacity=capacity, fps=fps,
+              input_shape=(), input_dtype=np.uint8)
+    app.rollback_component("pos", (2,), jnp.int32, checksum=True)
+    app.rollback_component("vel", (2,), jnp.int32, checksum=True)
+    app.rollback_component("handle", (), jnp.int32, checksum=True)
+    app.set_step(step)
+
+    def setup(world):
+        for h in range(num_players):
+            world, _ = spawn(
+                app.reg, world,
+                {"pos": np.array([(h * 2 - 1) * 2 * ONE, 0], np.int32),
+                 "vel": np.zeros(2, np.int32),
+                 "handle": h},
+            )
+        return world
+
+    app.set_setup(setup)
+    return app
+
+
+def to_float(q):
+    """Q16.16 -> float for display."""
+    return np.asarray(q, np.float64) / ONE

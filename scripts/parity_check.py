@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""SyncTest checksum parity across backends (BASELINE.md metric 2).
+
+Runs the same 12-frame fixed-point resim on the default device (TPU when
+available) AND on the host CPU backend, and compares the 64-bit checksums
+frame by frame.  Integer sim math -> must match EXACTLY.  Also reports the
+float box_game checksums for observation (not guaranteed across backends).
+
+Run from the repo root: python scripts/parity_check.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def run_on(device, app_maker, k=12):
+    import jax
+
+    from bevy_ggrs_tpu.session.events import InputStatus
+    from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+    app = app_maker()
+    rng = np.random.default_rng(7)
+    inputs = rng.integers(0, 16, (k, app.num_players)).astype(np.uint8)
+    status = np.full((k, app.num_players), InputStatus.CONFIRMED, np.int8)
+    with jax.default_device(device):
+        world = jax.device_put(app.init_state(), device)
+        _, _, checks = app.resim_fn(world, inputs, status, 0, -1)
+        checks = np.asarray(checks)
+    return [checksum_to_int(c) for c in checks]
+
+
+def main():
+    import jax
+
+    from bevy_ggrs_tpu.models import box_game, fixed_point
+
+    default_dev = jax.devices()[0]
+    cpu_dev = jax.devices("cpu")[0]
+    print(f"default backend: {default_dev.platform}, cpu: {cpu_dev.platform}")
+
+    fp_default = run_on(default_dev, fixed_point.make_app)
+    fp_cpu = run_on(cpu_dev, fixed_point.make_app)
+    exact = fp_default == fp_cpu
+    print(f"fixed-point parity ({default_dev.platform} vs cpu): "
+          f"{'EXACT MATCH' if exact else 'MISMATCH'}")
+    if not exact:
+        for i, (a, b) in enumerate(zip(fp_default, fp_cpu)):
+            if a != b:
+                print(f"  frame {i+1}: {a:#018x} != {b:#018x}")
+
+    bg_default = run_on(default_dev, box_game.make_app)
+    bg_cpu = run_on(cpu_dev, box_game.make_app)
+    print(f"float box_game parity (informational): "
+          f"{'match' if bg_default == bg_cpu else 'differs (expected for f32 cross-backend)'}")
+    return 0 if exact else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
