@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Equivalents of the reference's criterion benches
+(/root/reference/benches/bench.rs): advance_and_{load,save} over 1000
+single-type components and 3000 disjoint (3 types x 1000 entities),
+using the snapshot layer without any session — the reference's
+SnapshotPlugin-standalone pattern (bench.rs:49).
+
+Prints one JSON line per benchmark.  Run on any backend:
+    python benches/criterion_equiv.py [--iters N]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def build_app(n_types: int, n_entities: int):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from bevy_ggrs_tpu import App
+    from bevy_ggrs_tpu.snapshot import active_mask, spawn_many
+
+    names = ["c%d" % i for i in range(n_types)]
+    app = App(num_players=1, capacity=n_types * n_entities,
+              input_shape=(), input_dtype=np.uint8)
+    for n in names:
+        app.rollback_component(n, (), jnp.int32, checksum=True)
+
+    def step(world, ctx):
+        comps = dict(world.comps)
+        m = active_mask(world)
+        for n in names:
+            comps[n] = jnp.where(m & world.has[n], comps[n] + 1, comps[n])
+        return dataclasses.replace(world, comps=comps)
+
+    def setup(world):
+        for i, n in enumerate(names):
+            world = spawn_many(
+                app.reg, world,
+                {n: jnp.zeros((n_entities,), jnp.int32)}, count=n_entities,
+            )
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+    return app
+
+
+def bench(label, fn, iters):
+    import jax
+
+    jax.block_until_ready(fn())  # warmup/compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(json.dumps({"metric": label, "value": round(dt * 1e6, 2),
+                      "unit": "us/iter"}))
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=100)
+    args = ap.parse_args()
+
+    import jax
+
+    from bevy_ggrs_tpu.session.events import InputStatus
+
+    platform = jax.devices()[0].platform
+    print(json.dumps({"metric": "platform", "value": platform, "unit": ""}))
+
+    for n_types, n_entities, tag in ((1, 1000, "1000_components"),
+                                     (3, 1000, "3000_disjoint_components")):
+        app = build_app(n_types, n_entities)
+        world = app.init_state()
+        inputs = np.zeros((1, 1), np.uint8)
+        status = np.zeros((1, 1), np.int8)
+
+        # advance_and_save: one AdvanceWorld + SaveWorld (state retain is
+        # free; the measured cost is the advance + checksum, as one call)
+        def adv_save():
+            final, stacked, checks = app.resim_fn(world, inputs, status, 0, -1)
+            return checks
+
+        bench(f"advance_and_save_{tag}", adv_save, args.iters)
+
+        # advance_and_load: one AdvanceWorld + snapshot restore.  Restore is
+        # a host-side pytree rebind; we measure advance + a checksum read of
+        # the restored (original) state to keep the device honest.
+        final, stacked, checks = app.resim_fn(world, inputs, status, 0, -1)
+
+        def adv_load():
+            app.resim_fn(world, inputs, status, 0, -1)
+            restored = world  # O(1) rollback: rebind the retained pytree
+            return app.checksum_fn(restored)
+
+        bench(f"advance_and_load_{tag}", adv_load, args.iters)
+
+
+if __name__ == "__main__":
+    main()

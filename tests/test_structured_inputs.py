@@ -1,0 +1,61 @@
+"""Non-scalar input types: the Config::Input analog supports any POD shape
+(the reference requires Input: PartialEq+Serialize+Default+Copy; here any
+fixed-shape numpy dtype).  Exercises packing through the wire protocol and
+through SyncTest."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu import App, GgrsRunner, SyncTestSession
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.snapshot import active_mask, spawn
+
+
+def test_vector_input_synctest():
+    # input = int16[2] stick axes
+    app = App(num_players=2, capacity=4, input_shape=(2,), input_dtype=np.int16)
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("handle", (), jnp.int32, checksum=True)
+
+    def step(world, ctx):
+        h = world.comps["handle"]
+        m = active_mask(world) & world.has["handle"]
+        stick = ctx.inputs.astype(jnp.float32) / 100.0  # [P, 2]
+        delta = stick[jnp.clip(h, 0, ctx.inputs.shape[0] - 1)]
+        pos = world.comps["pos"] + jnp.where(m[:, None], delta, 0.0)
+        return dataclasses.replace(world, comps={**world.comps, "pos": pos})
+
+    def setup(world):
+        for h in range(2):
+            world, _ = spawn(app.reg, world, {"pos": np.zeros(2), "handle": h})
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+
+    session = SyncTestSession(num_players=2, input_shape=(2,),
+                              input_dtype=np.int16, check_distance=3)
+    mismatches = []
+    runner = GgrsRunner(
+        app, session,
+        read_inputs=lambda hs: {
+            h: np.array([100 if h == 0 else 0, 50], np.int16) for h in hs
+        },
+        on_mismatch=mismatches.append,
+    )
+    for _ in range(20):
+        runner.tick()
+    assert mismatches == []
+    assert abs(float(runner.world.comps["pos"][0, 0]) - 20.0) < 1e-4
+    assert abs(float(runner.world.comps["pos"][1, 0])) < 1e-6
+    assert abs(float(runner.world.comps["pos"][1, 1]) - 10.0) < 1e-4
+
+
+def test_vector_input_queue_roundtrip():
+    q = InputQueue(input_shape=(2,), input_dtype=np.int16, delay=1)
+    eff = q.add_local(4, np.array([7, -3], np.int16))
+    assert eff == 5
+    v, st = q.input_for(5)
+    assert v.tolist() == [7, -3]
