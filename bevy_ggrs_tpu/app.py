@@ -41,9 +41,13 @@ class App:
         input_shape: Tuple[int, ...] = (),
         input_dtype=np.uint8,
         seed: int = 0,
+        retention: int = 16,
     ):
         self.num_players = num_players
         self.fps = fps
+        # despawn-retirement horizon (frames); must be >= the session's
+        # max prediction window / check distance (see ops/resim.py docstring)
+        self.retention = retention
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
         self.seed = seed
@@ -141,15 +145,15 @@ class App:
 
     @cached_property
     def advance_fn(self):
-        return make_advance_fn(self.reg, self.step, self.fps, self.seed)
+        return make_advance_fn(self.reg, self.step, self.fps, self.seed, self.retention)
 
     @cached_property
     def resim_fn(self):
-        return make_resim_fn(self.reg, self.step, self.fps, self.seed)
+        return make_resim_fn(self.reg, self.step, self.fps, self.seed, self.retention)
 
     @cached_property
     def speculate_fn(self):
-        return make_speculate_fn(self.reg, self.step, self.fps, self.seed)
+        return make_speculate_fn(self.reg, self.step, self.fps, self.seed, self.retention)
 
     @cached_property
     def checksum_fn(self):

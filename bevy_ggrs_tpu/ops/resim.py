@@ -15,8 +15,15 @@ resim with a select (the north-star `jit(vmap(lax.scan(step)))` shape).
 Frame semantics match the reference: an AdvanceFrame request increments the
 frame counter *then* runs the step (schedule_systems.rs:251-268), so the step
 computing frame ``f`` sees ``ctx.frame == f`` and GgrsTime ``f / fps``
-(src/time.rs:63-87); confirmed-despawn sweeps run at the head of every advance
-(src/snapshot/set.rs:69-82).
+(src/time.rs:63-87); despawn-retirement sweeps run at the head of every
+advance (the DespawnConfirmed pass, src/snapshot/set.rs:69-82) — but at a
+FIXED retention horizon ``frame - retention`` instead of the dynamic
+confirmed frame: the confirmed frame depends on network timing and differs
+across peers, so freeing slots at it would make slot reuse (and thus later
+spawns) peer-dependent.  With ``retention >= max_prediction`` the horizon is
+always at or before the confirmed frame (the prediction-threshold stall
+guarantees ``current - confirmed <= max_prediction``), so retirement stays
+rollback-safe AND is a pure function of simulation state.
 """
 
 from __future__ import annotations
@@ -48,7 +55,7 @@ class StepCtx:
     inputs: jnp.ndarray  # [num_players, *input_shape]
     input_status: jnp.ndarray  # int8[num_players] (InputStatus)
     frame: jnp.ndarray  # int32 scalar — the frame being computed
-    confirmed: jnp.ndarray  # int32 scalar — last confirmed frame
+    retire_frame: jnp.ndarray  # int32 scalar — despawn-retirement horizon
     time_seconds: jnp.ndarray  # f32 scalar — GgrsTime total
     delta_seconds: jnp.ndarray  # f32 scalar — 1 / fps
     rng_key: jnp.ndarray  # jax PRNG key data
@@ -57,14 +64,14 @@ class StepCtx:
 StepFn = Callable[[WorldState, StepCtx], WorldState]
 
 
-def _make_ctx(inputs, status, frame, confirmed, fps: int, seed: int) -> StepCtx:
+def _make_ctx(inputs, status, frame, retire_frame, fps: int, seed: int) -> StepCtx:
     frame = jnp.asarray(frame, jnp.int32)
     key = jax.random.fold_in(jax.random.PRNGKey(seed), frame.astype(jnp.uint32))
     return StepCtx(
         inputs=inputs,
         input_status=status,
         frame=frame,
-        confirmed=jnp.asarray(confirmed, jnp.int32),
+        retire_frame=jnp.asarray(retire_frame, jnp.int32),
         time_seconds=frame.astype(jnp.float32) / fps,
         delta_seconds=jnp.float32(1.0 / fps),
         rng_key=key,
@@ -78,13 +85,17 @@ def advance(
     inputs,
     status,
     frame,
-    confirmed,
+    retention: int,
     fps: int,
     seed: int = 0,
 ) -> WorldState:
-    """One AdvanceWorld: confirmed-despawn sweep, then the user step."""
-    state = despawn_confirmed(reg, state, confirmed)
-    ctx = _make_ctx(inputs, status, frame, confirmed, fps, seed)
+    """One AdvanceWorld: despawn-retirement sweep, then the user step.
+
+    ``retention`` is static (baked into the compile); the sweep frees slots
+    whose deferred-despawn frame is <= frame - retention."""
+    retire = jnp.asarray(frame, jnp.int32) - jnp.int32(retention)
+    state = despawn_confirmed(reg, state, retire)
+    ctx = _make_ctx(inputs, status, frame, retire, fps, seed)
     return step_fn(state, ctx)
 
 
@@ -95,7 +106,7 @@ def resim(
     inputs_seq,  # [k, num_players, *input_shape]
     status_seq,  # int8[k, num_players]
     start_frame,  # int32: frame the state currently sits at
-    confirmed,
+    retention: int,
     fps: int,
     seed: int = 0,
 ) -> Tuple[WorldState, WorldState, jnp.ndarray]:
@@ -110,7 +121,7 @@ def resim(
         st, f = carry
         inp, stat = x
         nf = f + 1  # AdvanceFrame increments, then steps
-        st = advance(reg, step_fn, st, inp, stat, nf, confirmed, fps, seed)
+        st = advance(reg, step_fn, st, inp, stat, nf, retention, fps, seed)
         return (st, nf), (st, world_checksum(reg, st))
 
     (final, _), (stacked, checks) = jax.lax.scan(
@@ -119,30 +130,34 @@ def resim(
     return final, stacked, checks
 
 
-def make_advance_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
+def make_advance_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
+                    retention: int = 16):
     """jit-compiled single-frame advance returning (state, checksum)."""
 
     @jax.jit
-    def fn(state, inputs, status, frame, confirmed):
-        st = advance(reg, step_fn, state, inputs, status, frame, confirmed, fps, seed)
+    def fn(state, inputs, status, frame, _retire_unused=None):
+        st = advance(reg, step_fn, state, inputs, status, frame, retention, fps, seed)
         return st, world_checksum(reg, st)
 
     return fn
 
 
-def make_resim_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
+def make_resim_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
+                  retention: int = 16):
     """jit-compiled k-frame resim (one compile per distinct k)."""
 
     @jax.jit
-    def fn(state, inputs_seq, status_seq, start_frame, confirmed):
+    def fn(state, inputs_seq, status_seq, start_frame, _retire_unused=None):
         return resim(
-            reg, step_fn, state, inputs_seq, status_seq, start_frame, confirmed, fps, seed
+            reg, step_fn, state, inputs_seq, status_seq, start_frame, retention,
+            fps, seed
         )
 
     return fn
 
 
-def make_speculate_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
+def make_speculate_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
+                      retention: int = 16):
     """jit(vmap(scan)) — evaluate M speculative input branches in parallel.
 
     ``inputs_branches``: [M, k, P, *input_shape]; state is broadcast.  Returns
@@ -150,10 +165,10 @@ def make_speculate_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0):
     matching the arrived real inputs with :func:`select_branch`."""
 
     @jax.jit
-    def fn(state, inputs_branches, status_branches, start_frame, confirmed):
+    def fn(state, inputs_branches, status_branches, start_frame, _retire_unused=None):
         return jax.vmap(
             lambda inp, stat: resim(
-                reg, step_fn, state, inp, stat, start_frame, confirmed, fps, seed
+                reg, step_fn, state, inp, stat, start_frame, retention, fps, seed
             )
         )(inputs_branches, status_branches)
 

@@ -1,0 +1,108 @@
+"""Speculative rollback cache — driver-side branch fan-out.
+
+The capability beyond the reference (SURVEY §2.4 "Speculation"): while the
+session advances on *predicted* remote inputs, the driver simultaneously
+evaluates M candidate input branches for the same transition in ONE
+``jit(vmap(scan))`` dispatch.  When the real input arrives and the session
+requests a rollback, the first resimulated frame is looked up in the cache:
+a depth-1 rollback (the common case under mild jitter) becomes a branch
+select with zero extra device work; deeper rollbacks skip their first
+frame's recompute.
+
+Usage: pass ``SpeculationConfig`` to :class:`~bevy_ggrs_tpu.runner.GgrsRunner`.
+``candidates_fn(last_inputs) -> [M, P, *input_shape]`` enumerates the input
+combinations to hedge against (e.g. all 16 values of a 4-bit pad for the
+remote player, local inputs held fixed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class SpeculationConfig:
+    """candidates_fn: maps the inputs just used (``[P, *shape]``) to an
+    ``[M, P, *shape]`` array of candidate input rows for the SAME frame.
+    Should include likely corrections of the predicted players' inputs."""
+
+    candidates_fn: Callable[[np.ndarray], np.ndarray]
+    max_cached_frames: int = 4  # keep branches for the newest N start frames
+
+
+class SpeculationCache:
+    def __init__(self, app, config: SpeculationConfig):
+        self.app = app
+        self.config = config
+        # start_frame -> { input_bytes : (state, checksum) }
+        self._cache: Dict[int, Dict[bytes, Tuple]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.branches_evaluated = 0
+
+    def speculate(self, world, start_frame: int, used_inputs: np.ndarray) -> None:
+        """Fan out candidate branches for the (start_frame -> start_frame+1)
+        transition from ``world`` (the pre-advance state)."""
+        cands = np.asarray(
+            self.config.candidates_fn(used_inputs), self.app.input_dtype
+        )
+        m = cands.shape[0]
+        if m == 0:
+            return
+        branches = cands[:, None]  # [M, k=1, P, *shape]
+        statuses = np.zeros((m, 1, self.app.num_players), np.int8)
+        finals, stacked, checks = self.app.speculate_fn(
+            world, branches, statuses, start_frame
+        )
+        self.branches_evaluated += m
+        from .resim import select_branch
+
+        entry = {}
+        for b in range(m):
+            key = np.ascontiguousarray(cands[b]).tobytes()
+            entry[key] = (select_branch(finals, b), checks[b, 0])
+        self._cache[start_frame] = entry
+        # trim old start frames
+        for f in sorted(self._cache):
+            if len(self._cache) <= self.config.max_cached_frames:
+                break
+            del self._cache[f]
+
+    def lookup(self, start_frame: int, inputs: np.ndarray) -> Optional[Tuple]:
+        """(state, checksum) for advancing ``start_frame`` with ``inputs``,
+        if that branch was speculated."""
+        entry = self._cache.get(start_frame)
+        if entry is None:
+            self.misses += 1
+            return None
+        key = np.ascontiguousarray(
+            np.asarray(inputs, self.app.input_dtype)
+        ).tobytes()
+        got = entry.get(key)
+        if got is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return got
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+
+def pad_candidates(num_players: int, predicted_handles, values) -> Callable:
+    """Convenience candidates_fn: enumerate ``values`` for every predicted
+    handle (cartesian over handles), holding other players' inputs as used."""
+    import itertools
+
+    def fn(used_inputs: np.ndarray) -> np.ndarray:
+        combos = list(itertools.product(values, repeat=len(predicted_handles)))
+        out = np.repeat(used_inputs[None], len(combos), axis=0).copy()
+        for i, combo in enumerate(combos):
+            for h, v in zip(predicted_handles, combo):
+                out[i, h] = v
+        return out
+
+    return fn

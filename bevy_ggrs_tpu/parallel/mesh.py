@@ -65,16 +65,18 @@ def make_sharded_resim_fn(app: App, mesh: Mesh):
     Shapes: inputs_seq [k, P, ...]; returns (final, stacked, checksums) with
     the same entity-axis sharding on states."""
     fps, seed, reg, step = app.fps, app.seed, app.reg, app.step
+    retention = app.retention
 
     @jax.jit
-    def fn(world, inputs_seq, status_seq, start_frame, confirmed):
+    def fn(world, inputs_seq, status_seq, start_frame):
         return resim(
-            reg, step, world, inputs_seq, status_seq, start_frame, confirmed, fps, seed
+            reg, step, world, inputs_seq, status_seq, start_frame, retention,
+            fps, seed
         )
 
-    def wrapped(world, inputs_seq, status_seq, start_frame, confirmed):
+    def wrapped(world, inputs_seq, status_seq, start_frame, _unused=None):
         world = shard_world(app, mesh, world)
-        return fn(world, inputs_seq, status_seq, start_frame, confirmed)
+        return fn(world, inputs_seq, status_seq, start_frame)
 
     return wrapped
 
@@ -86,16 +88,17 @@ def make_sharded_speculate_fn(app: App, mesh: Mesh):
     broadcast world shards over "data".  One jit call evaluates all branches
     across the whole mesh."""
     fps, seed, reg, step = app.fps, app.seed, app.reg, app.step
+    retention = app.retention
 
     @jax.jit
-    def fn(world, inputs_branches, status_branches, start_frame, confirmed):
+    def fn(world, inputs_branches, status_branches, start_frame):
         return jax.vmap(
             lambda inp, stat: resim(
-                reg, step, world, inp, stat, start_frame, confirmed, fps, seed
+                reg, step, world, inp, stat, start_frame, retention, fps, seed
             )
         )(inputs_branches, status_branches)
 
-    def wrapped(world, inputs_branches, status_branches, start_frame, confirmed):
+    def wrapped(world, inputs_branches, status_branches, start_frame, _unused=None):
         world = shard_world(app, mesh, world)
         spec_sharding = NamedSharding(
             mesh, P(SPEC_AXIS, *([None] * (inputs_branches.ndim - 1)))
@@ -105,6 +108,6 @@ def make_sharded_speculate_fn(app: App, mesh: Mesh):
             status_branches,
             NamedSharding(mesh, P(SPEC_AXIS, *([None] * (status_branches.ndim - 1)))),
         )
-        return fn(world, inputs_branches, status_branches, start_frame, confirmed)
+        return fn(world, inputs_branches, status_branches, start_frame)
 
     return wrapped

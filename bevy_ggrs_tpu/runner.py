@@ -30,6 +30,7 @@ from .session.synctest import SyncTestSession
 from .snapshot.checksum import checksum_to_int
 from .snapshot.ring import SnapshotRing
 from .ops.resim import slice_frame
+from .ops.speculation import SpeculationCache, SpeculationConfig
 from .utils.frames import NULL_FRAME
 from .utils.tracing import span, trace_log
 
@@ -43,6 +44,7 @@ class GgrsRunner:
         on_event: Optional[Callable] = None,
         on_mismatch: Optional[Callable[[MismatchedChecksumError], None]] = None,
         initial_state=None,
+        speculation: Optional[SpeculationConfig] = None,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
@@ -59,6 +61,9 @@ class GgrsRunner:
         self.events: List = []
         self.session = None
         self.stalled_frames = 0  # PredictionThreshold skips (observability)
+        self.spec_cache = (
+            SpeculationCache(app, speculation) if speculation is not None else None
+        )
         if session is not None:
             self.set_session(session)
 
@@ -197,22 +202,39 @@ class GgrsRunner:
             self.frame = frame
 
     def _run_batch(self, run: List[GgrsRequest]) -> None:
-        """Execute a maximal Advance/Save run as one fused device call."""
+        """Execute a maximal Advance/Save run as one fused device call.
+
+        With speculation enabled, the first advance is served from the
+        speculative branch cache when its inputs were hedged last tick (a
+        depth-1 rollback becomes a select), and the live frame's predicted
+        transition fans out candidate branches for the next tick."""
         adv = [r for r in run if isinstance(r, AdvanceRequest)]
         k = len(adv)
         identity = self.app.reg.is_identity_strategy()
         pre_world, pre_checksum = self.world, self._world_checksum
         stacked = checks = None
-        if k > 0:
+        cached = None
+        if self.spec_cache is not None and k > 0:
+            cached = self.spec_cache.lookup(self.frame, adv[0].inputs)
+        skip = 0
+        if cached is not None:
+            self.world, self._world_checksum = cached
+            self.frame += 1
+            skip = 1
+        # state feeding the LAST advance (used to speculate the next tick)
+        last_adv_src = self.world
+        if k - skip > 0:
             with span("AdvanceWorld"):
-                inputs = np.stack([a.inputs for a in adv])
-                status = np.stack([a.status for a in adv])
+                inputs = np.stack([a.inputs for a in adv[skip:]])
+                status = np.stack([a.status for a in adv[skip:]])
                 final, stacked, checks = self.app.resim_fn(
-                    self.world, inputs, status, self.frame, self.confirmed
+                    self.world, inputs, status, self.frame
                 )
+                if k - skip >= 2:
+                    last_adv_src = slice_frame(stacked, k - skip - 2)
                 self.world = final
-                self._world_checksum = checks[k - 1]
-                self.frame += k
+                self._world_checksum = checks[k - skip - 1]
+                self.frame += k - skip
         with span("SaveWorld"):
             c = 0  # advances seen so far within the run
             for r in run:
@@ -221,12 +243,24 @@ class GgrsRunner:
                     continue
                 if c == 0:
                     state_s, cs = pre_world, pre_checksum
+                elif c == 1 and skip == 1:
+                    state_s, cs = cached
                 else:
-                    state_s = slice_frame(stacked, c - 1)
-                    cs = checks[c - 1]
+                    state_s = slice_frame(stacked, c - 1 - skip)
+                    cs = checks[c - 1 - skip]
                 stored = state_s if identity else self.app.reg.store_state(state_s)
                 self.ring.push(r.frame, (stored, cs))
                 r.cell.save(r.frame, _provider(cs))
+        # hedge the live frame: if its inputs were (partly) predicted, fan out
+        # candidate branches for the same transition
+        if (
+            self.spec_cache is not None
+            and k > 0
+            and np.any(adv[-1].status == InputStatus.PREDICTED)
+        ):
+            self.spec_cache.speculate(
+                last_adv_src, self.frame - 1, adv[-1].inputs
+            )
 
 
 def _provider(cs):

@@ -45,7 +45,8 @@ def test_fixed_point_eager_vs_jit_bit_exact():
     inputs, status = _inputs(8)
     from bevy_ggrs_tpu.ops.resim import resim
 
-    eager = resim(app.reg, app.step, world, inputs, status, 0, -1, app.fps, 0)
+    eager = resim(app.reg, app.step, world, inputs, status, 0, app.retention,
+                  app.fps, 0)
     jitted = app.resim_fn(world, inputs, status, 0, -1)
     assert np.array_equal(np.asarray(eager[2]), np.asarray(jitted[2]))
     assert np.array_equal(

@@ -16,8 +16,10 @@ from bevy_ggrs_tpu.models import box_game
 from bevy_ggrs_tpu.snapshot import active_count, active_mask, despawn_where, spawn
 
 
-def make_counter_app(despawn_at=None):
-    app = App(num_players=1, capacity=4, input_shape=(), input_dtype=np.uint8)
+def make_counter_app(despawn_at=None, retention=8):
+    # retention: despawn-retirement horizon; slots free at frame despawn+retention
+    app = App(num_players=1, capacity=4, input_shape=(), input_dtype=np.uint8,
+              retention=retention)
     app.rollback_component("counter", (), jnp.int32, checksum=True)
 
     def step(world, ctx):
@@ -83,13 +85,16 @@ def test_negative_control_detects_injected_nondeterminism():
 
 
 def test_despawn_across_rollback():
-    app = make_counter_app(despawn_at=10)
+    app = make_counter_app(despawn_at=10, retention=8)
     runner, mismatches = make_runner(app, check_distance=3)
-    for _ in range(20):
+    for _ in range(15):
+        runner.tick()
+    # entity disabled immediately, still allocated within the retention window
+    assert int(active_count(runner.world)) == 0
+    for _ in range(10):
         runner.tick()
     assert mismatches == []
-    # marker confirmed long ago -> slot hard-freed
-    assert int(active_count(runner.world)) == 0
+    # past frame despawn_at + retention -> slot hard-freed
     assert not bool(runner.world.alive[0])
 
 
