@@ -13,6 +13,7 @@ a native C++ core.
 from .app import App, DEFAULT_FPS
 from .runner import GgrsRunner
 from .ops.resim import StepCtx, select_branch, slice_frame
+from .ops.speculation import SpeculationConfig, SpeculationCache, pad_candidates
 from .session import (
     SyncTestSession,
     P2PSession,

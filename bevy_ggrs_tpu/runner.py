@@ -22,6 +22,7 @@ from .app import App
 from .session.events import (
     InputStatus,
     MismatchedChecksumError,
+    NotSynchronizedError,
     PredictionThresholdError,
     SessionState,
 )
@@ -143,6 +144,8 @@ class GgrsRunner:
             trace_log("frame %d skipped: prediction threshold", self.frame)
             self.stalled_frames += 1
             return
+        except NotSynchronizedError:
+            return  # still in the sync handshake; sim time does not advance
         self._drain_events()
         self._handle_requests(requests)
 
@@ -156,6 +159,8 @@ class GgrsRunner:
         except PredictionThresholdError:
             trace_log("spectator frame skipped: waiting for host input")
             self.stalled_frames += 1
+            return
+        except NotSynchronizedError:
             return
         self._handle_requests(requests)
 

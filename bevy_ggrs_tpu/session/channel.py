@@ -1,0 +1,64 @@
+"""In-process channel transport — the WebRTC/matchbox-analog alternative
+socket (the reference supports swapping `UdpNonBlockingSocket` for matchbox
+WebRTC behind the socket trait, README.md:79).  `ChannelNetwork` creates
+endpoints addressed by name with optional deterministic latency/loss — a
+pluggable `NonBlockingSocket` for tests and simulations that must not touch
+real sockets."""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+
+class ChannelNetwork:
+    """A little virtual packet network: named endpoints, FIFO per pair,
+    optional per-hop latency (in ``deliver`` calls) and loss rate."""
+
+    def __init__(self, latency_hops: int = 0, loss: float = 0.0, seed: int = 0):
+        self.latency_hops = latency_hops
+        self.loss = loss
+        self._rng = random.Random(seed)
+        self._queues: Dict[Any, Deque[Tuple[int, Any, bytes]]] = {}
+        self._clock = 0
+
+    def endpoint(self, name: Any) -> "ChannelSocket":
+        self._queues.setdefault(name, deque())
+        return ChannelSocket(self, name)
+
+    def deliver(self) -> None:
+        """Advance the virtual network one hop (ages queued packets)."""
+        self._clock += 1
+
+    def _send(self, src: Any, dst: Any, data: bytes) -> None:
+        if self.loss and self._rng.random() < self.loss:
+            return
+        q = self._queues.setdefault(dst, deque())
+        q.append((self._clock + self.latency_hops, src, data))
+
+    def _recv_all(self, name: Any) -> List[Tuple[Any, bytes]]:
+        q = self._queues.setdefault(name, deque())
+        out = []
+        while q and q[0][0] <= self._clock:
+            _, src, data = q.popleft()
+            out.append((src, data))
+        return out
+
+
+class ChannelSocket:
+    """NonBlockingSocket over a ChannelNetwork."""
+
+    def __init__(self, net: ChannelNetwork, name: Any):
+        self.net = net
+        self.name = name
+
+    @property
+    def local_addr(self) -> Any:
+        return self.name
+
+    def send_to(self, data: bytes, addr: Any) -> None:
+        self.net._send(self.name, addr, data)
+
+    def receive_all(self) -> List[Tuple[Any, bytes]]:
+        return self.net._recv_all(self.name)
