@@ -1,0 +1,114 @@
+"""Pallas TPU kernel for the per-entity checksum fold.
+
+The checksum hot loop (SURVEY §3.2: O(types x entities) per saved frame) is
+a bandwidth-bound integer fold.  XLA already fuses the jnp version well; this
+kernel exists to (a) fuse the *whole* per-type pipeline — bitcast, lane fold,
+id mix, mask, block-sum — into one VMEM pass with an explicit grid, and
+(b) serve as the template for future pallas work (quantized snapshot packing).
+
+Grid: one program per entity block (``block x L`` lanes resident in VMEM);
+each program writes one partial uint32 sum per stream; the final (tiny)
+reduction happens in jnp.  Falls back to interpret mode off-TPU, so tests
+exercise it on CPU; ``use_pallas_checksum(app)`` swaps it into an App.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..snapshot.checksum import _type_tag, fmix32, mix32, to_u32_lanes
+from ..snapshot.world import Registry, WorldState, active_mask
+
+_BLOCK = 512
+
+
+def _hash_block_kernel(lanes_ref, ids_ref, mask_ref, out_ref, *, n_lanes, seed_hi, seed_lo):
+    """One entity block: fold L lanes per row, mix the stable id, mask, and
+    emit the block's partial sum for both hash streams."""
+    lanes = lanes_ref[...]  # [B, L] uint32
+    ids = ids_ref[...]  # [B] uint32
+    mask = mask_ref[...]  # [B] bool (as uint32 0/1)
+    outs = []
+    for seed in (seed_hi, seed_lo):
+        h = jnp.full(lanes.shape[:1], seed, jnp.uint32)
+        for i in range(n_lanes):
+            h = mix32(h, lanes[:, i])
+        h = fmix32(h ^ jnp.uint32(n_lanes))
+        h = fmix32(mix32(h, ids))
+        h = jnp.where(mask != 0, h, jnp.uint32(0))
+        outs.append(jnp.sum(h, dtype=jnp.uint32))
+    out_ref[0] = outs[0]
+    out_ref[1] = outs[1]
+
+
+def component_part_pallas(
+    reg: Registry, w: WorldState, name: str, seeds, interpret: bool
+) -> jnp.ndarray:
+    """uint32[2] checksum part for one component via the pallas kernel."""
+    from jax.experimental import pallas as pl
+
+    spec = reg.components[name]
+    tag_hi = _type_tag(name, seeds[0])
+    tag_lo = _type_tag(name, seeds[1])
+    col = w.comps[name]
+    if spec.hash_fn is not None:
+        lanes = spec.hash_fn(col)
+        if lanes.ndim == 1:
+            lanes = lanes[:, None]
+        lanes = lanes.astype(jnp.uint32)
+    else:
+        lanes = to_u32_lanes(col)
+    n, l = lanes.shape
+    pad = (-n) % _BLOCK
+    if pad:
+        lanes = jnp.pad(lanes, ((0, pad), (0, 0)))
+    ids = jnp.pad(w.rollback_id.astype(jnp.uint32), (0, pad))
+    mask = jnp.pad(
+        (active_mask(w) & w.has[name]).astype(jnp.uint32), (0, pad)
+    )
+    blocks = (n + pad) // _BLOCK
+
+    kernel = functools.partial(
+        _hash_block_kernel, n_lanes=l,
+        seed_hi=np.uint32(tag_hi), seed_lo=np.uint32(tag_lo),
+    )
+    partials = pl.pallas_call(
+        kernel,
+        grid=(blocks,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK, l), lambda b: (b, 0)),
+            pl.BlockSpec((_BLOCK,), lambda b: (b,)),
+            pl.BlockSpec((_BLOCK,), lambda b: (b,)),
+        ],
+        out_specs=pl.BlockSpec((2,), lambda b: (b,)),
+        out_shape=jax.ShapeDtypeStruct((blocks * 2,), jnp.uint32),
+        interpret=interpret,
+    )(lanes, ids, mask)
+    partials = partials.reshape(blocks, 2)
+    sums = jnp.sum(partials, axis=0, dtype=jnp.uint32)
+    return jnp.stack(
+        [fmix32(sums[0] ^ jnp.uint32(tag_hi)), fmix32(sums[1] ^ jnp.uint32(tag_lo))]
+    )
+
+
+def world_checksum_pallas(reg: Registry, w: WorldState, interpret: bool = False):
+    """Drop-in replacement for snapshot.checksum.world_checksum using the
+    pallas block kernel for every checksummed component."""
+    from ..snapshot.checksum import _SEED_HI, _SEED_LO, entity_part, resource_part
+
+    hi = entity_part(w, _SEED_HI)
+    lo = entity_part(w, _SEED_LO)
+    for name, spec in reg.components.items():
+        if spec.checksum:
+            part = component_part_pallas(reg, w, name, (_SEED_HI, _SEED_LO), interpret)
+            hi = hi ^ part[0]
+            lo = lo ^ part[1]
+    for name, spec in reg.resources.items():
+        if spec.checksum:
+            hi = hi ^ resource_part(reg, w, name, _SEED_HI)
+            lo = lo ^ resource_part(reg, w, name, _SEED_LO)
+    return jnp.stack([hi, lo])
