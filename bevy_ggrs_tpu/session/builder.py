@@ -28,6 +28,7 @@ class SessionBuilder:
         self._disconnect_timeout_s = 2.0
         self._disconnect_notify_start_s = 0.5
         self._sparse_saving = False
+        self._input_predictor = None
 
     @classmethod
     def for_app(cls, app) -> "SessionBuilder":
@@ -55,6 +56,13 @@ class SessionBuilder:
 
     def with_desync_detection_mode(self, mode: DesyncDetection) -> "SessionBuilder":
         self._desync = mode
+        return self
+
+    def with_input_predictor(self, predictor) -> "SessionBuilder":
+        """Override remote-input prediction (the Config::InputPredictor slot,
+        SURVEY §2.3); default PredictRepeatLast.  ``predictor(queue, frame)``
+        returns the guessed input value."""
+        self._input_predictor = predictor
         return self
 
     def with_disconnect_timeout(self, seconds: float) -> "SessionBuilder":
@@ -92,6 +100,7 @@ class SessionBuilder:
             desync_detection=self._desync,
             disconnect_timeout_s=self._disconnect_timeout_s,
             disconnect_notify_start_s=self._disconnect_notify_start_s,
+            input_predictor=self._input_predictor,
         )
 
     def start_p2p_session_native(self, local_port: int = 0):

@@ -16,11 +16,25 @@ from ..utils.frames import NULL_FRAME, frame_gt, frame_le, frame_lt
 from .events import InputStatus
 
 
+def predict_repeat_last(queue: "InputQueue", frame: int):
+    """Default predictor: repeat the nearest earlier confirmed input
+    (``PredictRepeatLast``, /root/reference/src/lib.rs:59), default input
+    before the first real one."""
+    if queue.last_confirmed == NULL_FRAME:
+        return queue.default_input()
+    if frame_le(frame, queue.last_confirmed):
+        return queue._nearest_before(frame)
+    return queue._inputs[queue.last_confirmed]
+
+
 class InputQueue:
-    def __init__(self, input_shape=(), input_dtype=np.uint8, delay: int = 0):
+    def __init__(self, input_shape=(), input_dtype=np.uint8, delay: int = 0,
+                 predictor=None):
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
         self.delay = int(delay)
+        # the Config::InputPredictor analog: fn(queue, frame) -> input value
+        self.predictor = predictor or predict_repeat_last
         self._inputs: Dict[int, np.ndarray] = {}  # frame -> effective input
         self.last_confirmed = NULL_FRAME  # newest frame with a real input
         self._predictions: Dict[int, np.ndarray] = {}  # frame -> served guess
@@ -64,14 +78,9 @@ class InputQueue:
         the served guess recorded for later misprediction detection."""
         if frame in self._inputs:
             return self._inputs[frame], InputStatus.CONFIRMED
-        if self.last_confirmed != NULL_FRAME and frame_le(frame, self.last_confirmed):
-            # gap below the newest confirmed input (lost packet midstream):
-            # predict from the nearest earlier confirmed frame
-            pred = self._nearest_before(frame)
-        elif self.last_confirmed == NULL_FRAME:
-            pred = self.default_input()
-        else:
-            pred = self._inputs[self.last_confirmed]
+        pred = np.asarray(self.predictor(self, frame), self.input_dtype).reshape(
+            self.input_shape
+        )
         self._predictions[frame] = pred
         return pred, InputStatus.PREDICTED
 

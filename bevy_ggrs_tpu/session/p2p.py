@@ -49,6 +49,7 @@ class P2PSession:
         disconnect_timeout_s: float = 2.0,
         disconnect_notify_start_s: float = 0.5,
         sparse_saving: bool = False,
+        input_predictor=None,
     ):
         self._num_players = num_players
         self.socket = socket
@@ -77,7 +78,8 @@ class P2PSession:
 
         self.queues: Dict[int, InputQueue] = {
             h: InputQueue(self.input_shape, self.input_dtype,
-                          delay=input_delay if h in self.local_handles else 0)
+                          delay=input_delay if h in self.local_handles else 0,
+                          predictor=input_predictor)
             for h in range(num_players)
         }
 
