@@ -13,10 +13,16 @@ snapshot copy.  This is a *stronger* baseline than the reference's
 per-entity-HashMap data path (SURVEY §3.6), implemented in
 bench_baselines.py.  vs_baseline = device_fps / numpy_cpu_fps.
 
+Also reported: speculative fan-out throughput (16 branches x 8 frames per
+dispatch — the jit(vmap(scan)) north-star shape).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -24,6 +30,20 @@ import numpy as np
 N_ENTITIES = 10_000
 DEPTH = 8
 ITERS = 30
+SPEC_BRANCHES = 16
+
+
+def _device_backend_usable(timeout_s: int = 90) -> bool:
+    """Probe the default JAX backend in a subprocess (a wedged TPU tunnel can
+    hang jax.devices() indefinitely; don't let it take the benchmark down)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def bench_device():
@@ -33,22 +53,39 @@ def bench_device():
 
     app = stress.make_app(N_ENTITIES)
     world = app.init_state()
-    inputs = np.zeros((DEPTH, 2), np.uint8)
-    status = np.full((DEPTH, 2), InputStatus.CONFIRMED, np.int8)
+    import jax.numpy as jnp
+
+    inputs = jax.device_put(jnp.zeros((DEPTH, 2), jnp.uint8))
+    status = jax.device_put(
+        jnp.full((DEPTH, 2), InputStatus.CONFIRMED, jnp.int8)
+    )
 
     fn = app.resim_fn
-    # warmup/compile
-    final, stacked, checks = fn(world, inputs, status, 0, -1)
+    final, stacked, checks = fn(world, inputs, status, 0)
     jax.block_until_ready((final, stacked, checks))
-
     t0 = time.perf_counter()
+    w = world
     for i in range(ITERS):
-        final, stacked, checks = fn(world, inputs, status, i, -1)
-    jax.block_until_ready((final, stacked, checks))
+        w, stacked, checks = fn(w, inputs, status, i * DEPTH)
+    jax.block_until_ready(w)
     dt = time.perf_counter() - t0
     fps = DEPTH * ITERS / dt
+
+    # speculative fan-out: 16 branches x 8 frames in one dispatch
+    spec = app.speculate_fn
+    bi = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 2), jnp.uint8))
+    bs = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 2), jnp.int8))
+    out = spec(world, bi, bs, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        out = spec(world, bi, bs, i)
+    jax.block_until_ready(out)
+    sdt = time.perf_counter() - t0
+    spec_fps = SPEC_BRANCHES * DEPTH * ITERS / sdt
+
     platform = jax.devices()[0].platform
-    return fps, platform
+    return fps, spec_fps, platform
 
 
 def bench_numpy_baseline():
@@ -64,7 +101,13 @@ def bench_numpy_baseline():
 
 
 def main():
-    device_fps, platform = bench_device()
+    fallback = False
+    if not _device_backend_usable():
+        fallback = True
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    device_fps, spec_fps, platform = bench_device()
     cpu_fps = bench_numpy_baseline()
     result = {
         "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
@@ -72,9 +115,11 @@ def main():
         "unit": "frames/s",
         "vs_baseline": round(device_fps / cpu_fps, 2),
         "baseline_numpy_cpu_fps": round(cpu_fps, 1),
+        "speculative_16branch_resim_fps": round(spec_fps, 1),
         "platform": platform,
         "entities": N_ENTITIES,
         "rollback_depth": DEPTH,
+        "tpu_fallback_to_cpu": fallback,
     }
     print(json.dumps(result))
 
