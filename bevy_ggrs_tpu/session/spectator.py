@@ -109,7 +109,17 @@ class SpectatorSession:
             raise NotSynchronizedError()
         if self.current_frame not in self._inputs:
             raise PredictionThresholdError()  # waiting for host input
-        inputs = self._inputs.pop(self.current_frame)
+        # catch-up: when lagging the host, replay extra confirmed frames this
+        # tick (the reference spectator's catchup behavior)
+        n = 1
+        if self.frames_behind_host() > 2:
+            n += max(self.catchup_speed, 0)
         status = np.full((self._num_players,), InputStatus.CONFIRMED, np.int8)
-        self.current_frame += 1
-        return [AdvanceRequest(np.asarray(inputs), status)]
+        requests: List = []
+        for _ in range(n):
+            if self.current_frame not in self._inputs:
+                break
+            inputs = self._inputs.pop(self.current_frame)
+            self.current_frame += 1
+            requests.append(AdvanceRequest(np.asarray(inputs), status))
+        return requests

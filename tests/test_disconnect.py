@@ -1,0 +1,77 @@
+"""Failure handling: a silent peer triggers NetworkInterrupted then
+Disconnected (within configured timeouts), after which the surviving peer
+keeps simulating with DISCONNECTED input status for the dead player —
+the reference's failure model (SURVEY §5.3)."""
+
+import time
+
+import numpy as np
+
+from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.session.events import (
+    Disconnected,
+    InputStatus,
+    NetworkInterrupted,
+)
+
+DT = 1.0 / 60.0
+
+
+def test_peer_disconnect_survivor_continues():
+    net = ChannelNetwork()
+    socks = [net.endpoint("p0"), net.endpoint("p1")]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .with_disconnect_timeout(0.25)
+            .with_disconnect_notify_delay(0.08)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"p{1 - i}")
+        )
+        session = b.start_p2p_session(socks[i])
+        runners.append(
+            GgrsRunner(
+                app, session,
+                read_inputs=lambda hs: {h: box_game.keys_to_input(right=True)
+                                        for h in hs},
+            )
+        )
+    for _ in range(300):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+    for _ in range(20):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+    frame_at_death = runners[0].frame
+
+    # peer 1 dies; keep driving peer 0 in real time until events fire
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        net.deliver()
+        runners[0].update(DT)
+        if any(isinstance(e, Disconnected) for e in runners[0].events):
+            break
+        time.sleep(0.01)
+    kinds = [type(e) for e in runners[0].events]
+    assert NetworkInterrupted in kinds
+    assert Disconnected in kinds
+
+    # survivor stalls at most briefly, then advances freely (no remote inputs
+    # needed once the peer is disconnected)
+    before = runners[0].frame
+    for _ in range(30):
+        runners[0].update(DT)
+    assert runners[0].frame > before + 20
+    assert runners[0].frame > frame_at_death
+    # dead player's input arrives as DISCONNECTED status
+    inputs, status = runners[0].session._inputs_for(runners[0].frame - 1)
+    assert status[1] == InputStatus.DISCONNECTED
