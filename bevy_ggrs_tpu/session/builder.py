@@ -27,7 +27,6 @@ class SessionBuilder:
         self._players: List[Player] = []
         self._disconnect_timeout_s = 2.0
         self._disconnect_notify_start_s = 0.5
-        self._sparse_saving = False
         self._input_predictor = None
 
     @classmethod

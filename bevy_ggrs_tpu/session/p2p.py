@@ -48,7 +48,6 @@ class P2PSession:
         desync_detection: DesyncDetection = DesyncDetection.OFF,
         disconnect_timeout_s: float = 2.0,
         disconnect_notify_start_s: float = 0.5,
-        sparse_saving: bool = False,
         input_predictor=None,
     ):
         self._num_players = num_players
@@ -63,7 +62,6 @@ class P2PSession:
         self._confirmed = NULL_FRAME
         self.events_buf: List = []
         self._staged: Dict[int, np.ndarray] = {}
-        self.sparse_saving = sparse_saving
 
         self.local_handles: List[int] = []
         self.remote_handle_addr: Dict[int, Any] = {}

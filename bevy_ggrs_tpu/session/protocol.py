@@ -150,6 +150,12 @@ class PeerEndpoint:
     # -- receiving ----------------------------------------------------------
 
     def handle(self, data: bytes) -> None:
+        try:
+            self._handle(data)
+        except struct.error:
+            return  # truncated/malformed packet: drop (UDP is untrusted input)
+
+    def _handle(self, data: bytes) -> None:
         if len(data) < HDR.size:
             return
         magic, t = HDR.unpack_from(data)

@@ -233,11 +233,13 @@ struct Endpoint {
     switch (t) {
       case T_SYNC_REQ: {
         uint32_t nonce = r.u32();
+        if (!r.ok) break;
         Writer b; b.u32(nonce); send(T_SYNC_REP, b);
         break;
       }
       case T_SYNC_REP: {
         uint32_t nonce = r.u32();
+        if (!r.ok) break;
         if (state == GGRS_SYNCHRONIZING && nonce == sync_nonce) {
           sync_remaining--;
           sync_nonce = (uint32_t)(sync_nonce * 6364136223846793005ULL + 1ULL);
@@ -274,10 +276,15 @@ struct Endpoint {
         }
         break;
       }
-      case T_INPUT_ACK: note_ack(r.i32()); break;
+      case T_INPUT_ACK: {
+        Frame ack = r.i32();
+        if (r.ok) note_ack(ack);
+        break;
+      }
       case T_QUAL_REQ: {
         uint64_t ts = r.u64();
         int8_t adv = r.i8();
+        if (!r.ok) break;
         time_sync.note_remote(adv);
         remote_advantage = adv;
         Writer b; b.u64(ts); send(T_QUAL_REP, b);
@@ -285,6 +292,7 @@ struct Endpoint {
       }
       case T_QUAL_REP: {
         uint64_t ts = r.u64();
+        if (!r.ok) break;
         double rtt = now_s() - (double)ts / 1e6;
         if (rtt > 0) ping_s = rtt;
         break;
@@ -292,6 +300,7 @@ struct Endpoint {
       case T_CHECKSUM: {
         Frame f = r.i32();
         uint64_t cs = r.u64();
+        if (!r.ok) break;
         checksum_inbox.emplace_back(f, cs);
         break;
       }
