@@ -1,3 +1,4 @@
+from . import multihost
 from .mesh import (
     DATA_AXIS,
     SPEC_AXIS,
@@ -9,6 +10,7 @@ from .mesh import (
 )
 
 __all__ = [
+    "multihost",
     "DATA_AXIS",
     "SPEC_AXIS",
     "make_mesh",

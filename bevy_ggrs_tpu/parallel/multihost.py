@@ -1,0 +1,84 @@
+"""Multi-host scale-out: the distributed communication backend.
+
+Two distinct communication planes exist in this framework (SURVEY §5.8):
+
+1. **Peer input exchange** — tiny, latency-sensitive, host-side UDP/DCN,
+   handled by the session layer (python or native C++).  This never touches
+   the accelerator fabric; it is the analog of the reference's non-blocking
+   UDP core and scales with the number of *players*, not devices.
+
+2. **Simulation sharding** — when ONE peer's world is too big for one chip
+   (massive crowd sims, server-side lockstep worlds), the entity axis shards
+   over a multi-host ``jax.sharding.Mesh``; XLA places the collectives
+   (the checksum reduce, spawn cumsum/argmax) on ICI within a slice and DCN
+   across hosts.  This module wires that up.
+
+The mesh construction puts the entity ("data") axis on the FASTEST fabric:
+devices within a host/slice are contiguous along "data" so per-frame
+collectives ride ICI; the branch ("spec") axis — which only communicates at
+branch-select time — spans hosts.  With a single process this degrades to
+:func:`bevy_ggrs_tpu.parallel.make_mesh`.
+
+Typical SPMD deployment (one process per host, all running the same driver):
+
+    from bevy_ggrs_tpu.parallel import multihost
+    multihost.initialize(coordinator_address="host0:9999",
+                         num_processes=4, process_id=RANK)
+    mesh = multihost.make_multihost_mesh(n_spec=2)
+    resim = make_sharded_resim_fn(app, mesh)
+
+All hosts execute the same session-driven request stream (rollback netcode
+is already a replicated-state model — every peer simulates everything), so
+the only cross-host coordination needed beyond XLA collectives is identical
+inputs, which the session layer already guarantees.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .mesh import DATA_AXIS, SPEC_AXIS, Mesh
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """``jax.distributed.initialize`` passthrough (no-op if single-process
+    or already initialized)."""
+    if num_processes is None or num_processes <= 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError:
+        pass  # already initialized
+
+
+def make_multihost_mesh(n_spec: int = 1) -> Mesh:
+    """Global mesh over every device of every process.
+
+    Layout: devices are ordered process-major by ``jax.devices()``; we place
+    "spec" across the *process* (DCN) dimension first so the "data" axis —
+    which carries the per-frame collectives — stays within-host (ICI)."""
+    devs = np.array(jax.devices())
+    n = devs.size
+    if n % n_spec:
+        raise ValueError(f"{n} devices not divisible by n_spec={n_spec}")
+    grid = devs.reshape(n_spec, n // n_spec).T  # [data, spec]
+    return Mesh(grid, (DATA_AXIS, SPEC_AXIS))
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    return jax.process_index() == 0
