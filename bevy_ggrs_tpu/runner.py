@@ -32,7 +32,7 @@ from .snapshot.checksum import checksum_to_int
 from .snapshot.ring import SnapshotRing
 from .ops.resim import slice_frame
 from .ops.speculation import SpeculationCache, SpeculationConfig
-from .utils.frames import NULL_FRAME
+from .utils.frames import NULL_FRAME, frame_add, frame_ge
 from .utils.tracing import span, trace_log
 
 
@@ -82,6 +82,10 @@ class GgrsRunner:
         self.ring.clear()
         if session is not None:
             self.ring.set_depth(session.max_prediction() + 2)
+            # sessions may start at a nonzero frame (wraparound tests, resumed
+            # sessions); mirror it so ctx.frame/time agree from tick one
+            cur = getattr(session, "current_frame", 0)
+            self.frame = cur() if callable(cur) else cur
 
     # -- fixed-timestep driver (schedule_systems.rs:19-83) ------------------
 
@@ -224,7 +228,7 @@ class GgrsRunner:
         skip = 0
         if cached is not None:
             self.world, self._world_checksum = cached
-            self.frame += 1
+            self.frame = frame_add(self.frame, 1)
             skip = 1
         # state feeding the LAST advance (used to speculate the next tick)
         last_adv_src = self.world
@@ -239,7 +243,7 @@ class GgrsRunner:
                     last_adv_src = slice_frame(stacked, k - skip - 2)
                 self.world = final
                 self._world_checksum = checks[k - skip - 1]
-                self.frame += k - skip
+                self.frame = frame_add(self.frame, k - skip)
         with span("SaveWorld"):
             c = 0  # advances seen so far within the run
             for r in run:

@@ -17,7 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.frames import NULL_FRAME, frame_gt, frame_le, frame_lt, frame_min
+from ..utils.frames import NULL_FRAME, frame_add, frame_gt, frame_le, frame_lt, frame_min
 from .events import (
     DesyncDetected,
     DesyncDetection,
@@ -235,7 +235,8 @@ class P2PSession:
 
         # stall check BEFORE consuming inputs, so the tick can retry
         new_confirmed = self._compute_confirmed()
-        if self.current_frame - new_confirmed > self._max_prediction:
+        from ..utils.frames import frame_diff
+        if frame_diff(self.current_frame, new_confirmed) > self._max_prediction:
             self._staged.clear()
             raise PredictionThresholdError()
 
@@ -274,10 +275,12 @@ class P2PSession:
             first_incorrect, self.current_frame
         ):
             requests.append(LoadRequest(first_incorrect))
-            for i in range(first_incorrect, self.current_frame):
+            i = first_incorrect
+            while i != self.current_frame:
                 inputs, status = self._inputs_for(i)
                 requests.append(AdvanceRequest(inputs, status))
-                requests.append(SaveRequest(i + 1, SaveCell(self, i + 1)))
+                requests.append(SaveRequest(frame_add(i, 1), SaveCell(self, frame_add(i, 1))))
+                i = frame_add(i, 1)
             rolled_back = True
 
         self._confirmed = new_confirmed
@@ -289,7 +292,7 @@ class P2PSession:
             )
         inputs, status = self._inputs_for(self.current_frame)
         requests.append(AdvanceRequest(inputs, status))
-        self.current_frame += 1
+        self.current_frame = frame_add(self.current_frame, 1)
         self._stream_confirmed_to_spectators()
         return requests
 
@@ -317,7 +320,7 @@ class P2PSession:
         return c
 
     def _gc(self) -> None:
-        horizon = self._confirmed - self._max_prediction - 2
+        horizon = frame_add(self._confirmed, -self._max_prediction - 2)
         for q in self.queues.values():
             q.gc(horizon)
         acked = min(
@@ -346,7 +349,7 @@ class P2PSession:
                     v = self.queues[h].default_input()
                 rows.append(np.ascontiguousarray(v).tobytes())
             self._spectator_sent.append((f, b"".join(rows)))
-            self._next_spectator_frame += 1
+            self._next_spectator_frame = frame_add(self._next_spectator_frame, 1)
         acked = min(
             (ep.last_acked for ep in self.spectator_endpoints.values()),
             default=NULL_FRAME,

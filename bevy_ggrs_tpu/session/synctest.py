@@ -18,7 +18,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
-from ..utils.frames import NULL_FRAME
+from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import InputStatus, InvalidRequestError, MismatchedChecksumError
 from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 
@@ -32,6 +32,7 @@ class SyncTestSession:
         check_distance: int = 2,
         input_delay: int = 0,
         max_prediction: int = 8,
+        initial_frame: int = 0,
     ):
         self._num_players = num_players
         self.input_shape = tuple(input_shape)
@@ -39,7 +40,8 @@ class SyncTestSession:
         self.check_distance = int(check_distance)
         self.input_delay = int(input_delay)
         self._max_prediction = max(max_prediction, check_distance + 1)
-        self.current_frame = 0
+        self.current_frame = initial_frame
+        self._age = 0  # ticks since session start (rollback warmup gate)
         # frame -> [P, *shape] effective (post-delay) confirmed inputs
         self._inputs: Dict[int, np.ndarray] = {}
         self._staged: Dict[int, np.ndarray] = {}
@@ -57,7 +59,9 @@ class SyncTestSession:
     def confirmed_frame(self) -> int:
         if self.check_distance == 0:
             return self.current_frame
-        return max(self.current_frame - self.check_distance, NULL_FRAME)
+        if self._age < self.check_distance:
+            return NULL_FRAME  # session too young to have confirmed anything
+        return frame_add(self.current_frame, -self.check_distance)
 
     def add_local_input(self, handle: int, value) -> None:
         if not (0 <= handle < self._num_players):
@@ -74,7 +78,7 @@ class SyncTestSession:
 
         # apply input delay: input staged now takes effect at frame+delay;
         # frames before the first delayed input see the default (zero) input
-        eff_frame = self.current_frame + self.input_delay
+        eff_frame = frame_add(self.current_frame, self.input_delay)
         packed = np.stack(
             [self._staged[h] for h in range(self._num_players)]
         ).astype(self.input_dtype)
@@ -88,13 +92,16 @@ class SyncTestSession:
             AdvanceRequest(self._input_for(f), status),
         ]
         d = self.check_distance
-        if d > 0 and f + 1 >= d:
-            t = f + 1 - d
+        if d > 0 and self._age + 1 >= d:
+            t = frame_add(f, 1 - d)
             requests.append(LoadRequest(t))
-            for i in range(t, f + 1):
+            i = t
+            while i != frame_add(f, 1):
                 requests.append(AdvanceRequest(self._input_for(i), status))
-                requests.append(SaveRequest(i + 1, SaveCell(self, i + 1)))
-        self.current_frame = f + 1
+                requests.append(SaveRequest(frame_add(i, 1), SaveCell(self, frame_add(i, 1))))
+                i = frame_add(i, 1)
+        self.current_frame = frame_add(f, 1)
+        self._age += 1
         self._gc()
         return requests
 
@@ -128,8 +135,8 @@ class SyncTestSession:
 
     def _gc(self) -> None:
         # a frame can still receive saves until current passes it by d+1
-        horizon = self.current_frame - self.check_distance - 2
-        for fr in [fr for fr in self._cells if fr < horizon]:
+        horizon = frame_add(self.current_frame, -self.check_distance - 2)
+        for fr in [fr for fr in self._cells if frame_diff(fr, horizon) < 0]:
             del self._cells[fr]
-        for fr in [fr for fr in self._inputs if fr < horizon]:
+        for fr in [fr for fr in self._inputs if frame_diff(fr, horizon) < 0]:
             del self._inputs[fr]
