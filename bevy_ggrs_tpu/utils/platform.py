@@ -1,0 +1,24 @@
+"""Platform selection helper for CLIs and tests.
+
+Some environments pre-import jax with a platform pinned via sitecustomize,
+making JAX_PLATFORMS ineffective; ``apply_platform_env()`` applies the
+``BGT_PLATFORM`` env var (e.g. ``cpu``) through jax.config instead, plus an
+optional ``BGT_CPU_DEVICES`` virtual device count.  Called at the top of
+every example CLI so they are runnable anywhere (see docs/tpu_notes.md §4)."""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_env() -> None:
+    platform = os.environ.get("BGT_PLATFORM")
+    ndev = os.environ.get("BGT_CPU_DEVICES")
+    if not platform and not ndev:
+        return
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if ndev:
+        jax.config.update("jax_num_cpu_devices", int(ndev))

@@ -9,6 +9,10 @@ import time
 
 sys.path.insert(0, ".")
 
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
 from bevy_ggrs_tpu import GgrsRunner, SessionBuilder
 from bevy_ggrs_tpu.models import box_game
 

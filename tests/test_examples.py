@@ -1,0 +1,69 @@
+"""Smoke tests for the example CLIs (subprocess, CPU-pinned) — the analog of
+the reference keeping its examples compiling in CI (SURVEY §4.6)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(args, timeout=240):
+    env = dict(os.environ, BGT_PLATFORM="cpu")
+    return subprocess.run(
+        [sys.executable] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_box_game_synctest_example():
+    r = run_example(["examples/box_game_synctest.py", "--frames", "60",
+                     "--check-distance", "3"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "no mismatches" in r.stdout
+
+
+def test_particles_example_synctest():
+    r = run_example(["examples/particles_stress.py", "--rate", "10",
+                     "--ttl", "20", "--synctest", "--check-distance", "2",
+                     "--frames", "40"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "live particles" in r.stdout
+
+
+def test_box_game_p2p_pair_example():
+    import socket as so
+
+    socks = [so.socket(so.AF_INET, so.SOCK_DGRAM) for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    env = dict(os.environ, BGT_PLATFORM="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "examples/box_game_p2p.py",
+             "--local-port", str(ports[i]),
+             "--players"] +
+            (["local", f"127.0.0.1:{ports[1]}"] if i == 0
+             else [f"127.0.0.1:{ports[0]}", "local"]) +
+            ["--frames", "120"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert all("done at frame" in o for o in outs), outs[0][-500:]
