@@ -46,20 +46,16 @@ def _device_backend_usable(timeout_s: int = 90) -> bool:
         return False
 
 
-def bench_device():
+def _bench_layout(app):
     import jax
-    from bevy_ggrs_tpu.models import stress
+    import jax.numpy as jnp
     from bevy_ggrs_tpu.session.events import InputStatus
 
-    app = stress.make_app(N_ENTITIES)
     world = app.init_state()
-    import jax.numpy as jnp
-
     inputs = jax.device_put(jnp.zeros((DEPTH, 2), jnp.uint8))
     status = jax.device_put(
         jnp.full((DEPTH, 2), InputStatus.CONFIRMED, jnp.int8)
     )
-
     fn = app.resim_fn
     final, stacked, checks = fn(world, inputs, status, 0)
     jax.block_until_ready((final, stacked, checks))
@@ -68,8 +64,24 @@ def bench_device():
     for i in range(ITERS):
         w, stacked, checks = fn(w, inputs, status, i * DEPTH)
     jax.block_until_ready(w)
-    dt = time.perf_counter() - t0
-    fps = DEPTH * ITERS / dt
+    return DEPTH * ITERS / (time.perf_counter() - t0)
+
+
+def bench_device():
+    import jax
+    import jax.numpy as jnp
+    from bevy_ggrs_tpu.models import stress, stress_soa
+    from bevy_ggrs_tpu.session.events import InputStatus
+
+    # two layouts of the same workload: [N,3] matrices vs per-coordinate [N]
+    # scalar columns (lane-friendly on TPU, docs/tpu_notes.md §2)
+    fps_mat = _bench_layout(stress.make_app(N_ENTITIES))
+    fps_soa = _bench_layout(stress_soa.make_app(N_ENTITIES))
+    fps = max(fps_mat, fps_soa)
+    layout = "scalar_columns" if fps_soa >= fps_mat else "vec3_columns"
+
+    app = stress.make_app(N_ENTITIES)
+    world = app.init_state()
 
     # speculative fan-out: 16 branches x 8 frames in one dispatch
     spec = app.speculate_fn
@@ -85,7 +97,7 @@ def bench_device():
     spec_fps = SPEC_BRANCHES * DEPTH * ITERS / sdt
 
     platform = jax.devices()[0].platform
-    return fps, spec_fps, platform
+    return fps, spec_fps, platform, layout, fps_mat, fps_soa
 
 
 def bench_numpy_baseline():
@@ -107,7 +119,7 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    device_fps, spec_fps, platform = bench_device()
+    device_fps, spec_fps, platform, layout, fps_mat, fps_soa = bench_device()
     cpu_fps = bench_numpy_baseline()
     result = {
         "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
@@ -116,6 +128,9 @@ def main():
         "vs_baseline": round(device_fps / cpu_fps, 2),
         "baseline_numpy_cpu_fps": round(cpu_fps, 1),
         "speculative_16branch_resim_fps": round(spec_fps, 1),
+        "best_layout": layout,
+        "vec3_layout_fps": round(fps_mat, 1),
+        "scalar_columns_fps": round(fps_soa, 1),
         "platform": platform,
         "entities": N_ENTITIES,
         "rollback_depth": DEPTH,

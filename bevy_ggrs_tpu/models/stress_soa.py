@@ -1,0 +1,69 @@
+"""stress_soa — the benchmark workload with per-coordinate scalar columns.
+
+Same simulation as :mod:`stress` (Transform+Velocity under gravity with
+bounces), but each coordinate is its own ``[N]`` column (x/y/z/vx/vy/vz)
+instead of two ``[N, 3]`` matrices.  On TPU the entity axis then lands in
+the lane (minor) dimension and tiles (8,128) natively, where ``[N, 3]``
+pads the minor dim 3 -> 128 (docs/tpu_notes.md §2).  bench.py measures both
+layouts and reports the better one as the headline."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..app import App
+from ..snapshot.world import active_mask, spawn_many
+
+GRAVITY = np.float32(-9.8)
+BOUND = np.float32(50.0)
+
+_COLS = ("x", "y", "z", "vx", "vy", "vz")
+
+
+def step(world, ctx):
+    m = active_mask(world)
+    dt = ctx.delta_seconds
+    c = world.comps
+    vy = c["vy"] + GRAVITY * dt
+    new = {
+        "vx": c["vx"], "vy": vy, "vz": c["vz"],
+        "x": c["x"] + c["vx"] * dt,
+        "y": c["y"] + vy * dt,
+        "z": c["z"] + c["vz"] * dt,
+    }
+    for p, v in (("x", "vx"), ("y", "vy"), ("z", "vz")):
+        over = jnp.abs(new[p]) > BOUND
+        new[v] = jnp.where(over, -new[v], new[v])
+        new[p] = jnp.clip(new[p], -BOUND, BOUND)
+    return dataclasses.replace(
+        world, comps={k: jnp.where(m, new[k], c[k]) for k in _COLS}
+    )
+
+
+def make_app(n_entities: int = 10_000, capacity: int | None = None,
+             fps: int = 60, checksum: bool = True, seed: int = 0) -> App:
+    capacity = capacity or n_entities
+    app = App(num_players=2, capacity=capacity, fps=fps,
+              input_shape=(), input_dtype=np.uint8, seed=seed)
+    for name in _COLS:
+        app.rollback_component(name, (), jnp.float32, checksum=checksum)
+    app.set_step(step)
+
+    def setup(world):
+        rng = np.random.default_rng(seed)
+        cols = {}
+        for name in ("x", "y", "z"):
+            cols[name] = jnp.asarray(
+                rng.uniform(-40, 40, n_entities).astype(np.float32)
+            )
+        for name in ("vx", "vy", "vz"):
+            cols[name] = jnp.asarray(
+                rng.uniform(-5, 5, n_entities).astype(np.float32)
+            )
+        return spawn_many(app.reg, world, cols, count=n_entities)
+
+    app.set_setup(setup)
+    return app
