@@ -233,8 +233,20 @@ class P2PSession:
         if missing:
             raise InvalidRequestError(f"missing local input for {sorted(missing)}")
 
-        # stall check BEFORE consuming inputs, so the tick can retry
+        # stall check BEFORE consuming inputs, so the tick can retry.
+        # confirmed must NOT advance past a pending mispredicted frame: the
+        # rollback target has to stay in the driver's snapshot ring (a late
+        # redundant input batch can otherwise leapfrog it)
         new_confirmed = self._compute_confirmed()
+        pending_fi = NULL_FRAME
+        for q in self.queues.values():
+            f = q.first_incorrect
+            if f != NULL_FRAME and (
+                pending_fi == NULL_FRAME or frame_lt(f, pending_fi)
+            ):
+                pending_fi = f
+        if pending_fi != NULL_FRAME:
+            new_confirmed = frame_min(new_confirmed, pending_fi)
         from ..utils.frames import frame_diff
         if frame_diff(self.current_frame, new_confirmed) > self._max_prediction:
             self._staged.clear()

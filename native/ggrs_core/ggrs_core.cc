@@ -569,6 +569,14 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
     if (!s->staged.count(h)) return GGRS_ERR_INVALID_REQUEST;
 
   Frame new_confirmed = compute_confirmed(s);
+  /* confirmed must not advance past a pending mispredicted frame — the
+   * rollback target has to remain loadable from the driver's ring */
+  for (auto &q : s->queues) {
+    Frame fi = q.first_incorrect;
+    if (fi != NULL_FRAME &&
+        (new_confirmed == NULL_FRAME || frame_lt(fi, new_confirmed)))
+      new_confirmed = fi;
+  }
   if (frame_diff(s->current_frame, new_confirmed) > s->max_prediction) {
     s->staged.clear();
     return GGRS_ERR_PREDICTION_THRESHOLD;
