@@ -65,6 +65,13 @@ class GgrsRunner:
         self.spec_cache = (
             SpeculationCache(app, speculation) if speculation is not None else None
         )
+        # observability counters (network_stats covers the wire; these cover
+        # the sim driver — rollback frequency/depth is THE rollback-netcode
+        # health metric)
+        self.ticks = 0
+        self.rollbacks = 0
+        self.rollback_frames = 0  # total resimulated frames
+        self.device_dispatches = 0
         if session is not None:
             self.set_session(session)
 
@@ -106,6 +113,21 @@ class GgrsRunner:
             self._step_session()
             fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
 
+    def stats(self) -> dict:
+        """Driver health counters (rollback frequency/depth, dispatches,
+        stalls, speculation hit rate)."""
+        return {
+            "ticks": self.ticks,
+            "rollbacks": self.rollbacks,
+            "resimulated_frames": self.rollback_frames,
+            "device_dispatches": self.device_dispatches,
+            "stalled_frames": self.stalled_frames,
+            "speculation_hits": getattr(self.spec_cache, "hits", 0),
+            "speculation_misses": getattr(self.spec_cache, "misses", 0),
+            "frame": self.frame,
+            "confirmed": self.confirmed,
+        }
+
     def tick(self) -> None:
         """Run exactly one GGRS frame (manual-clock test pattern — the
         TimeUpdateStrategy::ManualDuration analog, tests/common/mod.rs:45-55)."""
@@ -114,6 +136,7 @@ class GgrsRunner:
     # -- per-session-type steps ---------------------------------------------
 
     def _step_session(self) -> None:
+        self.ticks += 1
         s = self.session
         if isinstance(s, SyncTestSession):
             self._step_synctest()
@@ -204,6 +227,7 @@ class GgrsRunner:
     def _load(self, frame: int) -> None:
         """LoadGameState: restore the ring snapshot for ``frame``
         (schedule_systems.rs:238-249)."""
+        self.rollbacks += 1
         with span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
             self.world = self.app.reg.load_state(stored)
@@ -233,6 +257,8 @@ class GgrsRunner:
         # state feeding the LAST advance (used to speculate the next tick)
         last_adv_src = self.world
         if k - skip > 0:
+            self.device_dispatches += 1
+            self.rollback_frames += max(k - skip - 1, 0)
             with span("AdvanceWorld"):
                 inputs = np.stack([a.inputs for a in adv[skip:]])
                 status = np.stack([a.status for a in adv[skip:]])
