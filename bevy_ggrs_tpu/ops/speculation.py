@@ -26,10 +26,19 @@ import numpy as np
 @dataclass
 class SpeculationConfig:
     """candidates_fn: maps the inputs just used (``[P, *shape]``) to an
-    ``[M, P, *shape]`` array of candidate input rows for the SAME frame.
-    Should include likely corrections of the predicted players' inputs."""
+    ``[M, P, *shape]`` array of candidate input rows.  Should include likely
+    corrections of the predicted players' inputs.
+
+    ``depth``: each branch extends its candidate row ``depth`` frames forward
+    (repeat-last continuation — matching how PredictRepeatLast mispredicts:
+    the remote *held* an input we did not guess).  A rollback of d <= depth
+    frames whose corrected inputs are constant and hedged becomes a cache
+    select of the d-th stacked state; depth=1 recovers single-frame hedging.
+    The speculate dispatch costs M x depth frames of device work per
+    predicted tick (the north-star 16 branches x 8 frames shape)."""
 
     candidates_fn: Callable[[np.ndarray], np.ndarray]
+    depth: int = 1
     max_cached_frames: int = 4  # keep branches for the newest N start frames
 
 
@@ -44,52 +53,81 @@ class SpeculationCache:
         self.branches_evaluated = 0
 
     def speculate(self, world, start_frame: int, used_inputs: np.ndarray) -> None:
-        """Fan out candidate branches for the (start_frame -> start_frame+1)
-        transition from ``world`` (the pre-advance state)."""
+        """Fan out candidate branches from ``world`` (the pre-advance state):
+        each candidate input row held constant for ``config.depth`` frames."""
         cands = np.asarray(
             self.config.candidates_fn(used_inputs), self.app.input_dtype
         )
         m = cands.shape[0]
         if m == 0:
             return
-        branches = cands[:, None]  # [M, k=1, P, *shape]
-        statuses = np.zeros((m, 1, self.app.num_players), np.int8)
+        depth = max(self.config.depth, 1)
+        # [M, depth, P, *shape]: candidate row repeated along the frame axis
+        branches = np.repeat(cands[:, None], depth, axis=1)
+        statuses = np.zeros((m, depth, self.app.num_players), np.int8)
         finals, stacked, checks = self.app.speculate_fn(
             world, branches, statuses, start_frame
         )
-        self.branches_evaluated += m
-        from .resim import select_branch
-
+        self.branches_evaluated += m * depth
         entry = {}
         for b in range(m):
             key = np.ascontiguousarray(cands[b]).tobytes()
-            entry[key] = (select_branch(finals, b), checks[b, 0])
-        self._cache[start_frame] = entry
+            # per-branch stacked states [depth, ...] + checksums [depth, 2]
+            entry[key] = (
+                jax_tree_slice(stacked, b),
+                checks[b],
+            )
+        self._cache[start_frame] = (depth, entry)
         # trim old start frames
         for f in sorted(self._cache):
             if len(self._cache) <= self.config.max_cached_frames:
                 break
             del self._cache[f]
 
-    def lookup(self, start_frame: int, inputs: np.ndarray) -> Optional[Tuple]:
-        """(state, checksum) for advancing ``start_frame`` with ``inputs``,
-        if that branch was speculated."""
-        entry = self._cache.get(start_frame)
-        if entry is None:
-            self.misses += 1
-            return None
-        key = np.ascontiguousarray(
-            np.asarray(inputs, self.app.input_dtype)
-        ).tobytes()
-        got = entry.get(key)
+    def lookup_seq(self, start_frame: int, inputs_seq: np.ndarray) -> Optional[Tuple]:
+        """Longest cached prefix for advancing ``start_frame`` with the frame
+        sequence ``inputs_seq [k, P, *shape]``.
+
+        Returns (d, states_fn, checks) where d is the number of frames served:
+        ``states_fn(i)`` yields the state after advance i (0-based, i < d) and
+        ``checks[i]`` its checksum — or None on miss.  Matches only constant
+        input prefixes (branches hold their candidate)."""
+        got = self._cache.get(start_frame)
         if got is None:
             self.misses += 1
-        else:
-            self.hits += 1
-        return got
+            return None
+        depth, entry = got
+        seq = np.asarray(inputs_seq, self.app.input_dtype)
+        key = np.ascontiguousarray(seq[0]).tobytes()
+        branch = entry.get(key)
+        if branch is None:
+            self.misses += 1
+            return None
+        d = 1
+        while d < min(depth, seq.shape[0]) and np.array_equal(seq[d], seq[0]):
+            d += 1
+        stacked_b, checks_b = branch
+        self.hits += 1
+        from .resim import slice_frame
+
+        return d, (lambda i: slice_frame(stacked_b, i)), checks_b
+
+    def lookup(self, start_frame: int, inputs: np.ndarray) -> Optional[Tuple]:
+        """Single-frame convenience: (state, checksum) or None."""
+        got = self.lookup_seq(start_frame, np.asarray(inputs)[None])
+        if got is None:
+            return None
+        d, states_fn, checks = got
+        return states_fn(0), checks[0]
 
     def clear(self) -> None:
         self._cache.clear()
+
+
+def jax_tree_slice(tree, idx):
+    import jax
+
+    return jax.tree.map(lambda a: a[idx], tree)
 
 
 def pad_candidates(num_players: int, predicted_handles, values) -> Callable:

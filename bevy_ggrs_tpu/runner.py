@@ -246,16 +246,21 @@ class GgrsRunner:
         identity = self.app.reg.is_identity_strategy()
         pre_world, pre_checksum = self.world, self._world_checksum
         stacked = checks = None
-        cached = None
-        if self.spec_cache is not None and k > 0:
-            cached = self.spec_cache.lookup(self.frame, adv[0].inputs)
         skip = 0
-        if cached is not None:
-            self.world, self._world_checksum = cached
-            self.frame = frame_add(self.frame, 1)
-            skip = 1
+        cache_states = cache_checks = None
+        if self.spec_cache is not None and k > 0:
+            got = self.spec_cache.lookup_seq(
+                self.frame, np.stack([a.inputs for a in adv])
+            )
+            if got is not None:
+                skip, cache_states, cache_checks = got
+                self.world = cache_states(skip - 1)
+                self._world_checksum = cache_checks[skip - 1]
+                self.frame = frame_add(self.frame, skip)
         # state feeding the LAST advance (used to speculate the next tick)
         last_adv_src = self.world
+        if skip == k and skip >= 2:
+            last_adv_src = cache_states(skip - 2)
         if k - skip > 0:
             self.device_dispatches += 1
             self.rollback_frames += max(k - skip - 1, 0)
@@ -278,8 +283,8 @@ class GgrsRunner:
                     continue
                 if c == 0:
                     state_s, cs = pre_world, pre_checksum
-                elif c == 1 and skip == 1:
-                    state_s, cs = cached
+                elif c <= skip:
+                    state_s, cs = cache_states(c - 1), cache_checks[c - 1]
                 else:
                     state_s = slice_frame(stacked, c - 1 - skip)
                     cs = checks[c - 1 - skip]

@@ -112,3 +112,57 @@ def test_cache_miss_on_unhedged_input():
     assert runner.spec_cache.hits == 0
     assert runner.spec_cache.misses >= 1
     assert runner.frame == 2  # still correct via plain resim
+
+
+def make_deep_script(session, corrected, depth):
+    """Depth-``depth`` rollback: live-advance ``depth`` predicted frames, then
+    the real (constant) remote input arrives for all of them."""
+    RIGHT = box_game.keys_to_input(right=True)
+    predicted = [RIGHT, 0]
+    actual = [RIGHT, corrected]
+
+    def save(f):
+        return SaveRequest(f, SaveCell(session, f))
+
+    ticks = []
+    for f in range(depth):
+        ticks.append([save(f), adv(predicted, predicted=True)])
+    rollback = [LoadRequest(0)]
+    for f in range(depth):
+        rollback += [adv(actual), save(f + 1)]
+    rollback.append(adv(actual, predicted=True))
+    ticks.append(rollback)
+    return ticks
+
+
+def run_deep(speculation, depth=3):
+    app = box_game.make_app(num_players=2)
+    corrected = box_game.keys_to_input(up=True)
+    session = ScriptedSession([])
+    session.script = make_deep_script(session, corrected, depth)
+    runner = GgrsRunner(app, session, speculation=speculation)
+    for _ in range(depth + 1):
+        runner.tick()
+    return runner
+
+
+def test_depth_k_cache_serves_whole_rollback():
+    depth = 3
+    spec = SpeculationConfig(
+        candidates_fn=pad_candidates(2, [1], list(range(16))), depth=4
+    )
+    r_spec = run_deep(spec, depth)
+    r_plain = run_deep(None, depth)
+    assert r_spec.spec_cache.hits >= 1
+    assert r_spec.frame == r_plain.frame == depth + 1
+    # the whole catch-up was served from one cached branch: the final tick
+    # dispatched only the live frame, not the depth-frame resim
+    assert r_spec.device_dispatches < r_plain.device_dispatches
+    assert np.array_equal(
+        np.asarray(r_spec.world.comps["pos"]), np.asarray(r_plain.world.comps["pos"])
+    )
+    assert checksum_to_int(r_spec._world_checksum) == checksum_to_int(
+        r_plain._world_checksum
+    )
+    for f in range(1, depth + 1):
+        assert r_spec.session.saved[f]() == r_plain.session.saved[f]()
