@@ -24,6 +24,10 @@ def test_lossy_network_stays_in_sync(loss, latency):
             SessionBuilder.for_app(app)
             .with_input_delay(2)
             .with_max_prediction_window(8)
+            # generous timeout: in-suite jit compiles stall the loop for
+            # seconds; a one-sided fake disconnect legitimately diverges sims
+            .with_disconnect_timeout(60.0)
+            .with_disconnect_notify_delay(30.0)
             .add_player(PlayerType.LOCAL, i)
             .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a")
         )
