@@ -144,10 +144,10 @@ class NativeP2PSession:
                 rc = self._lib.ggrs_p2p_add_player(
                     self._s, 1, p.handle, ip.encode(), int(port)
                 )
-            else:
-                raise InvalidRequestError(
-                    "native session does not host spectators yet; use the "
-                    "python P2PSession for spectator streaming"
+            else:  # spectator: host streams confirmed all-player inputs
+                ip, port = p.address
+                rc = self._lib.ggrs_p2p_add_player(
+                    self._s, 2, p.handle, ip.encode(), int(port)
                 )
             if rc != _OK:
                 raise InvalidRequestError(f"add_player failed rc={rc}")

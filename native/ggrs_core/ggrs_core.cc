@@ -419,7 +419,12 @@ struct GgrsP2P {
   std::vector<int> local_handles;
   std::map<int, Addr> remote_handle_addr;
   std::map<Addr, std::unique_ptr<Endpoint>> endpoints;
+  std::map<Addr, std::unique_ptr<Endpoint>> spectator_endpoints;
   std::map<Addr, std::vector<int>> handles_of_addr;
+  std::vector<Addr> spectator_addrs;
+  /* confirmed all-player input rows streamed to spectators */
+  std::deque<std::pair<Frame, std::vector<uint8_t>>> spectator_sent;
+  Frame next_spectator_frame = 0;
   std::vector<InputQueue> queues;
   std::map<int, std::vector<uint8_t>> staged;
   std::deque<std::pair<Frame, std::vector<uint8_t>>> local_sent;
@@ -470,7 +475,11 @@ int ggrs_p2p_add_player(GgrsP2P *s, int kind, int handle, const char *ip,
     s->handles_of_addr[a].push_back(handle);
     return GGRS_OK;
   }
-  return GGRS_ERR_INVALID_REQUEST; /* spectators: python layer for now */
+  if (kind == GGRS_SPECTATOR) {
+    s->spectator_addrs.push_back(a);
+    return GGRS_OK;
+  }
+  return GGRS_ERR_INVALID_REQUEST;
 }
 
 int ggrs_p2p_start(GgrsP2P *s) {
@@ -487,6 +496,17 @@ int ggrs_p2p_start(GgrsP2P *s) {
     ep->disconnect_notify_s = s->disconnect_notify_s;
     ep->init(t);
     s->endpoints[addr] = std::move(ep);
+  }
+  for (auto &addr : s->spectator_addrs) {
+    auto ep = std::make_unique<Endpoint>();
+    ep->addr = addr;
+    ep->sock = &s->sock;
+    ep->input_size = s->input_size * s->num_players; /* full-row stream */
+    ep->sync_nonce = s->rng();
+    ep->disconnect_timeout_s = s->disconnect_timeout_s;
+    ep->disconnect_notify_s = s->disconnect_notify_s;
+    ep->init(t);
+    s->spectator_endpoints[addr] = std::move(ep);
   }
   s->started = true;
   return GGRS_OK;
@@ -506,7 +526,18 @@ void ggrs_p2p_poll(GgrsP2P *s) {
   int n;
   while ((n = s->sock.recv_from(&from, buf, sizeof buf)) >= 0) {
     auto it = s->endpoints.find(from);
-    if (it != s->endpoints.end()) it->second->handle(buf, (size_t)n);
+    if (it != s->endpoints.end()) { it->second->handle(buf, (size_t)n); continue; }
+    auto st = s->spectator_endpoints.find(from);
+    if (st != s->spectator_endpoints.end()) st->second->handle(buf, (size_t)n);
+  }
+  for (auto &[addr, ep] : s->spectator_endpoints) {
+    ep->poll();
+    for (auto &e : ep->events) s->events.push_back(e);
+    ep->events.clear();
+    ep->inbox.clear();
+    ep->checksum_inbox.clear();
+    if (ep->state == GGRS_RUNNING && !ep->disconnected)
+      ep->send_inputs(s->spectator_sent);
   }
   for (auto &[addr, ep] : s->endpoints) {
     if (ep->last_received_frame != NULL_FRAME) {
@@ -675,6 +706,35 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
     return GGRS_ERR_BUFFER_TOO_SMALL;
   if (!emit_advance(s->current_frame)) return GGRS_ERR_BUFFER_TOO_SMALL;
   s->current_frame++;
+
+  /* stream newly confirmed all-player input rows to spectators */
+  if (!s->spectator_endpoints.empty() && s->confirmed != NULL_FRAME) {
+    while (frame_le(s->next_spectator_frame, s->confirmed)) {
+      Frame f = s->next_spectator_frame;
+      std::vector<uint8_t> row;
+      row.reserve((size_t)s->num_players * s->input_size);
+      for (int h = 0; h < s->num_players; h++) {
+        const auto *v = s->queues[h].confirmed(f);
+        if (v) row.insert(row.end(), v->begin(), v->end());
+        else row.insert(row.end(), (size_t)s->input_size, 0);
+      }
+      s->spectator_sent.emplace_back(f, std::move(row));
+      s->next_spectator_frame = f + 1;
+    }
+    Frame acked = NULL_FRAME;
+    bool first_sp = true;
+    for (auto &[a2, ep] : s->spectator_endpoints) {
+      if (first_sp || (acked != NULL_FRAME && ep->last_acked != NULL_FRAME &&
+                       frame_lt(ep->last_acked, acked)))
+        acked = ep->last_acked;
+      first_sp = false;
+    }
+    while (!s->spectator_sent.empty() && acked != NULL_FRAME &&
+           frame_le(s->spectator_sent.front().first, acked))
+      s->spectator_sent.pop_front();
+    if ((int)s->spectator_sent.size() > 2 * MAX_INPUTS_PER_PACKET)
+      s->spectator_sent.pop_front();
+  }
   *n_req_words = rw;
   *n_input_bytes = ib;
   return GGRS_OK;

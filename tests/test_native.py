@@ -194,3 +194,37 @@ def test_native_stall_without_remote():
     assert r0.frame <= 9  # max_prediction 8 + initial frame
     assert r0.stalled_frames > 0
     sock.close()
+
+
+def test_native_host_python_spectator():
+    from bevy_ggrs_tpu import SpectatorSession
+    from bevy_ggrs_tpu.session.events import PlayerType as PT
+
+    p0, p1, p_spec = free_ports(3)
+    # native host (streams to the spectator) + native peer
+    app0 = box_game.make_app(num_players=2)
+    b0 = (
+        SessionBuilder.for_app(app0)
+        .with_input_delay(1)
+        .add_player(PlayerType.LOCAL, 0)
+        .add_player(PlayerType.REMOTE, 1, ("127.0.0.1", p1))
+        .add_player(PlayerType.SPECTATOR, 2, ("127.0.0.1", p_spec))
+    )
+    r0 = GgrsRunner(
+        app0, b0.start_p2p_session_native(local_port=p0),
+        read_inputs=lambda hs: {h: box_game.keys_to_input(right=True) for h in hs},
+    )
+    r1 = make_native_runner(1, p1, p0, input_delay=1)
+
+    spec_app = box_game.make_app(num_players=2)
+    spec_sock = UdpNonBlockingSocket(p_spec, host="0.0.0.0")
+    spec_session = SessionBuilder.for_app(spec_app).start_spectator_session(
+        ("127.0.0.1", p0), spec_sock
+    )
+    r_spec = GgrsRunner(spec_app, spec_session)
+    everyone = [r0, r1, r_spec]
+    assert sync_all(everyone)
+    interleave(everyone, 100)
+    assert r_spec.frame > 20
+    assert float(r_spec.world.comps["pos"][0, 0]) > 1.9  # replayed movement
+    spec_sock.close()
