@@ -46,11 +46,13 @@ class GgrsRunner:
         on_mismatch: Optional[Callable[[MismatchedChecksumError], None]] = None,
         initial_state=None,
         speculation: Optional[SpeculationConfig] = None,
+        on_advance: Optional[Callable] = None,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
         self.on_event = on_event
         self.on_mismatch = on_mismatch
+        self.on_advance = on_advance  # (frame, inputs, status) per AdvanceFrame
         self.world = initial_state if initial_state is not None else app.init_state()
         self._world_checksum = app.checksum_fn(self.world)
         self.ring: SnapshotRing = SnapshotRing(depth=8)
@@ -245,6 +247,10 @@ class GgrsRunner:
         k = len(adv)
         identity = self.app.reg.is_identity_strategy()
         pre_world, pre_checksum = self.world, self._world_checksum
+        pre_frame = self.frame
+        if self.on_advance is not None:
+            for i, a in enumerate(adv):
+                self.on_advance(frame_add(pre_frame, i + 1), a.inputs, a.status)
         stacked = checks = None
         skip = 0
         cache_states = cache_checks = None

@@ -26,6 +26,7 @@ from .p2p import P2PSession
 from .spectator import SpectatorSession
 from .builder import SessionBuilder
 from .native import NativeP2PSession, native_available
+from .replay import InputRecorder, ReplaySession
 
 __all__ = [
     "InputStatus",
@@ -60,4 +61,6 @@ __all__ = [
     "SessionBuilder",
     "NativeP2PSession",
     "native_available",
+    "InputRecorder",
+    "ReplaySession",
 ]

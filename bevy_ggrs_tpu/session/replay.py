@@ -1,0 +1,110 @@
+"""Input-stream recording and deterministic replay.
+
+A rollback-netcode session is fully determined by its confirmed input
+stream, so recording (frame -> all-player inputs) gives free match replays
+and a desync post-mortem tool: re-run the recording against any build and
+compare checksums frame by frame.  (The reference has no replay facility;
+this is a natural extension of its determinism model.)
+
+``InputRecorder`` plugs into :class:`~bevy_ggrs_tpu.runner.GgrsRunner` via
+the ``on_advance`` hook and keeps the LAST fully-confirmed inputs seen for
+each frame (a frame advanced on predictions is later re-advanced with
+confirmed inputs during the rollback — the final all-confirmed advance is
+the truth).  ``ReplaySession`` feeds a recording back through the normal
+driver as an advance-only session."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .events import InputStatus, PredictionThresholdError
+from .requests import AdvanceRequest
+
+
+class InputRecorder:
+    def __init__(self, num_players: int, input_shape=(), input_dtype=np.uint8):
+        self.num_players = num_players
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.frames: Dict[int, np.ndarray] = {}
+
+    @classmethod
+    def for_app(cls, app) -> "InputRecorder":
+        return cls(app.num_players, app.input_shape, app.input_dtype)
+
+    def on_advance(self, frame: int, inputs: np.ndarray, status: np.ndarray) -> None:
+        """Runner hook: called for every executed AdvanceFrame request."""
+        if np.all(status == InputStatus.CONFIRMED):
+            self.frames[frame] = np.array(inputs, self.input_dtype)
+
+    def __len__(self) -> int:
+        return len(self.frames)
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        keys = sorted(self.frames)
+        np.savez_compressed(
+            path,
+            frames=np.array(keys, np.int64),
+            inputs=np.stack([self.frames[k] for k in keys])
+            if keys
+            else np.zeros((0, self.num_players, *self.input_shape), self.input_dtype),
+            num_players=self.num_players,
+            input_shape=np.array(self.input_shape, np.int64),
+            input_dtype=str(self.input_dtype),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "InputRecorder":
+        z = np.load(path, allow_pickle=False)
+        rec = cls(
+            int(z["num_players"]),
+            tuple(int(x) for x in z["input_shape"]),
+            np.dtype(str(z["input_dtype"])),
+        )
+        for f, row in zip(z["frames"], z["inputs"]):
+            rec.frames[int(f)] = row.astype(rec.input_dtype)
+        return rec
+
+
+class ReplaySession:
+    """Advance-only session feeding a recording (GGRS session surface)."""
+
+    is_spectator = True  # drives the advance-only runner path
+
+    def __init__(self, recording: InputRecorder, start_frame: Optional[int] = None):
+        self.rec = recording
+        frames = sorted(recording.frames)
+        self.current_frame = start_frame if start_frame is not None else (
+            frames[0] if frames else 0
+        )
+        self.end_frame = frames[-1] + 1 if frames else 0
+
+    def num_players(self) -> int:
+        return self.rec.num_players
+
+    def max_prediction(self) -> int:
+        return 0
+
+    def confirmed_frame(self) -> int:
+        return self.current_frame - 1
+
+    def current_state(self):
+        from .events import SessionState
+
+        return SessionState.RUNNING
+
+    @property
+    def finished(self) -> bool:
+        return self.current_frame >= self.end_frame
+
+    def advance_frame(self) -> List:
+        if self.current_frame not in self.rec.frames:
+            raise PredictionThresholdError()  # gap or end of recording
+        inputs = self.rec.frames[self.current_frame]
+        self.current_frame += 1
+        status = np.full((self.rec.num_players,), InputStatus.CONFIRMED, np.int8)
+        return [AdvanceRequest(inputs, status)]

@@ -1,0 +1,55 @@
+"""Disk persistence for world state — save/resume beyond the in-memory ring.
+
+The reference keeps checkpoints only in memory (its ring IS the rollback
+feature; "no disk persistence anywhere", SURVEY §5.4).  Here a WorldState is
+a flat pytree of arrays, so durable checkpoints are nearly free; combined
+with :mod:`..session.replay` they enable resume, golden-state regression
+tests, and desync bisection across builds."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from .world import Registry, WorldState
+
+_FORMAT_VERSION = 1
+
+
+def save_world(path: str, reg: Registry, world: WorldState, frame: int = 0) -> None:
+    leaves, treedef = jax.tree.flatten(world)
+    np.savez_compressed(
+        path,
+        __version__=_FORMAT_VERSION,
+        __frame__=frame,
+        __n_leaves__=len(leaves),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+
+
+def load_world(path: str, reg: Registry) -> Tuple[WorldState, int]:
+    """Returns (world, frame).  The registry must match the one that saved
+    (same registered components/resources — the treedef is reconstructed
+    from ``reg.init_state()``)."""
+    z = np.load(path, allow_pickle=False)
+    if int(z["__version__"]) != _FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {z['__version__']}")
+    template = reg.init_state()
+    t_leaves, treedef = jax.tree.flatten(template)
+    n = int(z["__n_leaves__"])
+    if n != len(t_leaves):
+        raise ValueError(
+            f"checkpoint has {n} leaves; registry expects {len(t_leaves)} "
+            "(registered types changed?)"
+        )
+    leaves = []
+    for i, t in enumerate(t_leaves):
+        arr = z[f"leaf_{i}"]
+        if arr.shape != tuple(t.shape):
+            raise ValueError(
+                f"leaf {i} shape {arr.shape} != registry shape {tuple(t.shape)}"
+            )
+        leaves.append(jax.numpy.asarray(arr, t.dtype))
+    return jax.tree.unflatten(treedef, leaves), int(z["__frame__"])
