@@ -126,20 +126,23 @@ class PeerEndpoint:
         self.send_raw(data)
 
     def send_inputs(self, pending: List[Tuple[int, bytes]]) -> None:
-        """Send all un-acked inputs (redundant packet).  ``pending`` is an
-        ascending [(effective_frame, raw_bytes)] list."""
+        """Send all un-acked inputs (redundant packets, chunked).  ``pending``
+        is an ascending [(effective_frame, raw_bytes)] list.  Chunking (up to
+        4 packets per call) keeps slow receivers — late-joining or lossy
+        spectators — from ever seeing a truncation gap they cannot fill."""
         pending = [p for p in pending if frame_gt(p[0], self.last_acked)]
-        pending = pending[-MAX_INPUTS_PER_PACKET:]
         self.send_queue_len = len(pending)
         if not pending:
             return
-        start = pending[0][0]
-        body = S_INPUT.pack(
-            start, len(pending), self.last_received_frame,
-            int(np.clip(self.local_advantage, -127, 127)),
-        )
-        body += b"".join(p[1] for p in pending)
-        self._send(T_INPUT, body)
+        for c in range(0, min(len(pending), 4 * MAX_INPUTS_PER_PACKET),
+                       MAX_INPUTS_PER_PACKET):
+            chunk = pending[c:c + MAX_INPUTS_PER_PACKET]
+            body = S_INPUT.pack(
+                chunk[0][0], len(chunk), self.last_received_frame,
+                int(np.clip(self.local_advantage, -127, 127)),
+            )
+            body += b"".join(p[1] for p in chunk)
+            self._send(T_INPUT, body)
 
     def send_input_ack(self) -> None:
         self._send(T_INPUT_ACK, S_INPUT_ACK.pack(self.last_received_frame))
@@ -252,7 +255,12 @@ class PeerEndpoint:
                 ),
             )
         if t - self._last_send >= KEEP_ALIVE_S:
-            self._send(T_KEEP_ALIVE)
+            # keepalives double as input acks: a stalled peer that sends no
+            # INPUT packets must still acknowledge what it received
+            if self.last_received_frame != NULL_FRAME:
+                self.send_input_ack()
+            else:
+                self._send(T_KEEP_ALIVE)
         quiet = t - self._last_recv
         if quiet >= self.disconnect_timeout_s:
             self.disconnected = True

@@ -195,22 +195,25 @@ struct Endpoint {
   }
 
   void send_inputs(const std::deque<std::pair<Frame, std::vector<uint8_t>>> &pending) {
-    /* redundant packet: every un-acked input, capped */
+    /* redundant packets, chunked: slow receivers (late spectators) must
+     * never see a truncation gap they cannot fill */
     std::vector<const std::pair<Frame, std::vector<uint8_t>> *> out;
     for (auto &p : pending)
       if (last_acked == NULL_FRAME || frame_gt(p.first, last_acked)) out.push_back(&p);
-    if ((int)out.size() > MAX_INPUTS_PER_PACKET)
-      out.erase(out.begin(), out.end() - MAX_INPUTS_PER_PACKET);
     send_queue_len = (int)out.size();
     if (out.empty()) return;
-    Writer b;
-    b.i32(out.front()->first);
-    b.u16((uint16_t)out.size());
-    b.i32(last_received_frame);
-    int adv = local_advantage; if (adv > 127) adv = 127; if (adv < -127) adv = -127;
-    b.i8((int8_t)adv);
-    for (auto *p : out) b.bytes(p->second.data(), p->second.size());
-    send(T_INPUT, b);
+    size_t limit = std::min(out.size(), (size_t)(4 * MAX_INPUTS_PER_PACKET));
+    for (size_t c = 0; c < limit; c += MAX_INPUTS_PER_PACKET) {
+      size_t end = std::min(c + (size_t)MAX_INPUTS_PER_PACKET, limit);
+      Writer b;
+      b.i32(out[c]->first);
+      b.u16((uint16_t)(end - c));
+      b.i32(last_received_frame);
+      int adv = local_advantage; if (adv > 127) adv = 127; if (adv < -127) adv = -127;
+      b.i8((int8_t)adv);
+      for (size_t i = c; i < end; i++) b.bytes(out[i]->second.data(), out[i]->second.size());
+      send(T_INPUT, b);
+    }
   }
 
   void send_input_ack() { Writer b; b.i32(last_received_frame); send(T_INPUT_ACK, b); }
@@ -323,7 +326,10 @@ struct Endpoint {
       b.i8((int8_t)adv);
       send(T_QUAL_REQ, b);
     }
-    if (t - last_send >= KEEP_ALIVE_S) { Writer b; send(T_KEEP_ALIVE, b); }
+    if (t - last_send >= KEEP_ALIVE_S) {
+      if (last_received_frame != NULL_FRAME) send_input_ack();
+      else { Writer b; send(T_KEEP_ALIVE, b); }
+    }
     double quiet = t - last_recv;
     if (quiet >= disconnect_timeout_s) {
       disconnected = true;
@@ -732,7 +738,9 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
     while (!s->spectator_sent.empty() && acked != NULL_FRAME &&
            frame_le(s->spectator_sent.front().first, acked))
       s->spectator_sent.pop_front();
-    if ((int)s->spectator_sent.size() > 2 * MAX_INPUTS_PER_PACKET)
+    /* hard cap: a spectator >8 chunks (~8.5 s at 60fps) behind starts
+     * losing the oldest frames (it should have been catching up) */
+    while ((int)s->spectator_sent.size() > 8 * MAX_INPUTS_PER_PACKET)
       s->spectator_sent.pop_front();
   }
   *n_req_words = rw;
