@@ -1,0 +1,102 @@
+"""crowd — large-scale flocking model (the multi-chip showcase).
+
+Unlike box_game/particles (pure per-entity physics), each crowd member
+steers toward its team's centroid and away from the global center of mass —
+cross-entity *reductions* that exercise the MXU (the team reduction is a
+one-hot ``[N, T] @ [N, 2]`` matmul) and, under entity-axis sharding, XLA
+collectives (the segment sums become psums on the mesh).  Inputs steer each
+player's team (one team per player handle).
+
+All reductions are sums of f32 — deterministic within a backend for a fixed
+sharding, and the order is fixed by the mesh, so SyncTest stays clean; for
+cross-backend lobbies use the fixed_point model instead (docs/determinism.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..app import App
+from ..ops.resim import StepCtx
+from ..snapshot.world import WorldState, active_mask, spawn_many
+
+COHESION = np.float32(0.4)
+REPULSION = np.float32(0.15)
+STEER = np.float32(2.0)
+DRAG = np.float32(0.98)
+BOUND = np.float32(30.0)
+
+
+def make_step(app: App, num_teams: int):
+    def step(world: WorldState, ctx: StepCtx) -> WorldState:
+        m = active_mask(world) & world.has["team"]
+        mf = m.astype(jnp.float32)
+        pos, vel = world.comps["pos"], world.comps["vel"]
+        team = jnp.clip(world.comps["team"], 0, num_teams - 1)
+
+        # team centroids via one-hot matmul (MXU work; psum under sharding)
+        onehot = jax.nn.one_hot(team, num_teams, dtype=jnp.float32) * mf[:, None]
+        team_sum = onehot.T @ pos  # [T, 2]
+        team_cnt = jnp.maximum(onehot.sum(axis=0), 1.0)  # [T]
+        centroids = team_sum / team_cnt[:, None]
+
+        # global center of mass (repulsion keeps teams apart)
+        total = jnp.maximum(mf.sum(), 1.0)
+        com = (pos * mf[:, None]).sum(axis=0) / total
+
+        # player steering: input bitmask accelerates the whole team
+        inp = ctx.inputs.reshape(-1)[jnp.clip(team, 0, ctx.inputs.shape[0] - 1)]
+        inp = jnp.where(m, inp, 0).astype(jnp.int32)
+
+        def bit(b):
+            return ((inp >> b) & 1).astype(jnp.float32)
+
+        steer = jnp.stack([bit(3) - bit(2), bit(1) - bit(0)], axis=-1) * STEER
+
+        to_centroid = centroids[team] - pos
+        from_com = pos - com[None, :]
+        acc = COHESION * to_centroid + REPULSION * from_com + steer
+        vel = (vel + acc * ctx.delta_seconds) * DRAG
+        pos = jnp.clip(pos + vel * ctx.delta_seconds, -BOUND, BOUND)
+
+        m2 = m[:, None]
+        return dataclasses.replace(
+            world,
+            comps={
+                **world.comps,
+                "pos": jnp.where(m2, pos, world.comps["pos"]),
+                "vel": jnp.where(m2, vel, world.comps["vel"]),
+            },
+        )
+
+    return step
+
+
+def make_app(n_per_team: int = 512, num_teams: int = 2, capacity: int | None = None,
+             fps: int = 60, seed: int = 0) -> App:
+    n = n_per_team * num_teams
+    capacity = capacity or n
+    app = App(num_players=num_teams, capacity=capacity, fps=fps,
+              input_shape=(), input_dtype=np.uint8, seed=seed)
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("vel", (2,), jnp.float32, checksum=True)
+    app.rollback_component("team", (), jnp.int32, checksum=True)
+    app.set_step(make_step(app, num_teams))
+
+    def setup(world):
+        rng = np.random.default_rng(seed)
+        pos = rng.uniform(-20, 20, (n, 2)).astype(np.float32)
+        team = np.repeat(np.arange(num_teams, dtype=np.int32), n_per_team)
+        return spawn_many(
+            app.reg, world,
+            {"pos": jnp.asarray(pos), "vel": jnp.zeros((n, 2), jnp.float32),
+             "team": jnp.asarray(team)},
+            count=n,
+        )
+
+    app.set_setup(setup)
+    return app
