@@ -14,7 +14,7 @@ ambiguity detection to Error (lib.rs:236-246)."""
 from __future__ import annotations
 
 from functools import cached_property
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np

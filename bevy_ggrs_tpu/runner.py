@@ -32,7 +32,7 @@ from .snapshot.checksum import checksum_to_int
 from .snapshot.ring import SnapshotRing
 from .ops.resim import slice_frame
 from .ops.speculation import SpeculationCache, SpeculationConfig
-from .utils.frames import NULL_FRAME, frame_add, frame_ge
+from .utils.frames import NULL_FRAME, frame_add
 from .utils.tracing import span, trace_log
 
 

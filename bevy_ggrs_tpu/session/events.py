@@ -4,8 +4,8 @@ user code consume (reconstructed API per SURVEY.md §2.3; citations inline)."""
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
-from typing import Any, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, List, Optional
 
 
 class InputStatus(enum.IntEnum):

@@ -13,16 +13,14 @@ from __future__ import annotations
 import ctypes as C
 import os
 import subprocess
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
-from ..utils.frames import NULL_FRAME
 from .events import (
     DesyncDetected,
     DesyncDetection,
     Disconnected,
-    InputStatus,
     InvalidRequestError,
     NetworkInterrupted,
     NetworkResumed,

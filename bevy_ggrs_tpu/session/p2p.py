@@ -13,7 +13,7 @@ call (docs/architecture.md:21 request shapes)."""
 from __future__ import annotations
 
 import random
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 import numpy as np
 

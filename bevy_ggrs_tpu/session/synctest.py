@@ -14,7 +14,7 @@ surfaces it as a SyncTestMismatch event).  Confirmed frame =
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 

@@ -26,8 +26,6 @@ and the reference's own cross-platform warning,
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

@@ -23,7 +23,7 @@ Unit-test parity: tests/test_ring.py ports the battery at mod.rs:369-512.
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Deque, Generic, Optional, TypeVar
+from typing import Deque, Generic, Optional, TypeVar
 
 from ..utils.frames import frame_ge, frame_lt
 
