@@ -80,13 +80,13 @@ def bench_device():
     fps = max(fps_mat, fps_soa)
     layout = "scalar_columns" if fps_soa >= fps_mat else "vec3_columns"
 
-    app = stress.make_app(N_ENTITIES)
+    # speculative fan-out (BASELINE config 5: 4 players x 16 branches x
+    # 8 frames, over the 10k-entity world)
+    app = stress.make_app(N_ENTITIES, num_players=4)
     world = app.init_state()
-
-    # speculative fan-out: 16 branches x 8 frames in one dispatch
     spec = app.speculate_fn
-    bi = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 2), jnp.uint8))
-    bs = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 2), jnp.int8))
+    bi = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.uint8))
+    bs = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.int8))
     out = spec(world, bi, bs, 0)
     jax.block_until_ready(out)
     t0 = time.perf_counter()

@@ -80,6 +80,47 @@ def main():
     platform = jax.devices()[0].platform
     print(json.dumps({"metric": "platform", "value": platform, "unit": ""}))
 
+    # hierarchy rollback: 1k parent/child chains (BASELINE config 4)
+    from bevy_ggrs_tpu.models import box_game  # noqa: F401 (import warms jax)
+    import dataclasses
+    import jax.numpy as jnp
+    from bevy_ggrs_tpu import App
+    from bevy_ggrs_tpu.snapshot import Registry, active_mask, spawn_many
+
+    happ = App(num_players=1, capacity=2048, input_shape=(), input_dtype=np.uint8)
+    happ.register_hierarchy()
+    happ.rollback_component("v", (), jnp.int32, checksum=True)
+
+    def hstep(world, ctx):
+        m = active_mask(world) & world.has["v"]
+        return dataclasses.replace(
+            world,
+            comps={**world.comps,
+                   "v": jnp.where(m, world.comps["v"] + 1, world.comps["v"])},
+        )
+
+    def hsetup(world):
+        parents = jnp.full((1024,), -1, jnp.int32)
+        world = spawn_many(happ.reg, world,
+                           {Registry.PARENT: parents,
+                            "v": jnp.zeros((1024,), jnp.int32)}, count=1024)
+        children_parents = jnp.arange(1024, dtype=jnp.int32)
+        world = spawn_many(happ.reg, world,
+                           {Registry.PARENT: children_parents,
+                            "v": jnp.zeros((1024,), jnp.int32)}, count=1024)
+        return world
+
+    happ.set_step(hstep)
+    happ.set_setup(hsetup)
+    hworld = happ.init_state()
+    hin = np.zeros((8, 1), np.uint8)
+    hst = np.zeros((8, 1), np.int8)
+
+    def hier_resim():
+        return happ.resim_fn(hworld, hin, hst, 0)[2]
+
+    bench("hierarchy_rollback_1k_chains_8frames", hier_resim, args.iters)
+
     for n_types, n_entities, tag in ((1, 1000, "1000_components"),
                                      (3, 1000, "3000_disjoint_components")):
         app = build_app(n_types, n_entities)

@@ -35,9 +35,9 @@ def step(world, ctx):
 
 
 def make_app(n_entities: int = 10_000, capacity: int | None = None, fps: int = 60,
-             checksum: bool = True, seed: int = 0) -> App:
+             checksum: bool = True, seed: int = 0, num_players: int = 2) -> App:
     capacity = capacity or n_entities
-    app = App(num_players=2, capacity=capacity, fps=fps,
+    app = App(num_players=num_players, capacity=capacity, fps=fps,
               input_shape=(), input_dtype=np.uint8, seed=seed)
     app.rollback_component("pos", (3,), jnp.float32, checksum=checksum)
     app.rollback_component("vel", (3,), jnp.float32, checksum=checksum)
