@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Replay inspection tool — desync post-mortems from recorded input streams.
+
+    python scripts/replay_tool.py info match.npz
+    python scripts/replay_tool.py checksums match.npz --model box_game [--every 10]
+    python scripts/replay_tool.py diff a.npz b.npz
+
+`checksums` re-simulates the recording deterministically and prints per-frame
+checksums (compare outputs across builds/machines to locate a divergence
+frame); `diff` compares two recordings' input streams (e.g. the two peers'
+recordings of the same match — the first differing frame is where their
+realities split)."""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np
+
+
+def load(path):
+    from bevy_ggrs_tpu.session.replay import InputRecorder
+
+    return InputRecorder.load(path)
+
+
+def cmd_info(args):
+    rec = load(args.recording)
+    frames = sorted(rec.frames)
+    print(f"players:      {rec.num_players}")
+    print(f"input shape:  {rec.input_shape} {rec.input_dtype}")
+    print(f"frames:       {len(frames)}"
+          + (f" ({frames[0]}..{frames[-1]})" if frames else ""))
+    gaps = [f for f in range(frames[0], frames[-1]) if f not in rec.frames] if frames else []
+    print(f"gaps:         {len(gaps)}" + (f" first at {gaps[0]}" if gaps else ""))
+
+
+def cmd_checksums(args):
+    from bevy_ggrs_tpu import GgrsRunner
+    from bevy_ggrs_tpu.session.replay import ReplaySession
+    from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+    from bevy_ggrs_tpu import models
+
+    rec = load(args.recording)
+    app = getattr(models, args.model).make_app(num_players=rec.num_players)
+    runner = GgrsRunner(app, ReplaySession(rec))
+    while not runner.session.finished:
+        runner.tick()
+        if runner.frame % args.every == 0:
+            print(f"frame {runner.frame}: "
+                  f"{checksum_to_int(runner._world_checksum):#018x}")
+    print(f"final frame {runner.frame}: "
+          f"{checksum_to_int(runner._world_checksum):#018x}")
+
+
+def cmd_diff(args):
+    a, b = load(args.a), load(args.b)
+    frames = sorted(set(a.frames) | set(b.frames))
+    diverged = False
+    for f in frames:
+        va, vb = a.frames.get(f), b.frames.get(f)
+        if va is None or vb is None:
+            print(f"frame {f}: only in {'b' if va is None else 'a'}")
+            diverged = True
+        elif not np.array_equal(va, vb):
+            print(f"frame {f}: a={va.tolist()} b={vb.tolist()}")
+            diverged = True
+    print("recordings identical" if not diverged else "recordings DIFFER")
+    return 1 if diverged else 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("info")
+    p.add_argument("recording")
+    p = sub.add_parser("checksums")
+    p.add_argument("recording")
+    p.add_argument("--model", default="box_game")
+    p.add_argument("--every", type=int, default=10)
+    p = sub.add_parser("diff")
+    p.add_argument("a")
+    p.add_argument("b")
+    args = ap.parse_args()
+    rc = {"info": cmd_info, "checksums": cmd_checksums, "diff": cmd_diff}[args.cmd](args)
+    raise SystemExit(rc or 0)
+
+
+if __name__ == "__main__":
+    main()

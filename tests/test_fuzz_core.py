@@ -1,0 +1,117 @@
+"""Model-based randomized tests: SnapshotRing and InputQueue against naive
+reference models under thousands of random operations (the property version
+of the reference's hand-written unit batteries)."""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu.session.input_queue import InputQueue
+from bevy_ggrs_tpu.session.events import InputStatus
+from bevy_ggrs_tpu.snapshot.ring import MissingSnapshotError, SnapshotRing
+from bevy_ggrs_tpu.utils.frames import NULL_FRAME, frame_ge, frame_lt
+
+
+class NaiveRing:
+    """Spec model: ordered list of (frame, value), wrapping-frame order."""
+
+    def __init__(self, depth):
+        self.items = []  # ascending by wrapped order
+        self.depth = depth
+
+    def push(self, frame, value):
+        self.items = [it for it in self.items if frame_lt(it[0], frame)]
+        self.items.append((frame, value))
+        self.items = self.items[-self.depth:]
+
+    def confirm(self, frame):
+        self.items = [it for it in self.items if frame_ge(it[0], frame)]
+
+    def rollback(self, frame):
+        keep = [it for it in self.items if not frame_lt(frame, it[0])]
+        for f, v in keep:
+            if f == frame:
+                self.items = keep
+                return v
+        self.items = []
+        raise KeyError(frame)
+
+    def frames(self):
+        return [f for f, _ in reversed(self.items)]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_ring_matches_model(seed):
+    rng = np.random.default_rng(seed)
+    ring = SnapshotRing(depth=6)
+    model = NaiveRing(6)
+    frame = rng.integers(-(2**31), 2**31 - 100)
+    for _ in range(2000):
+        op = rng.integers(0, 10)
+        if op < 6:  # push a newer frame (usual save pattern)
+            frame = int(np.int32(frame + rng.integers(1, 3)))
+            v = int(rng.integers(0, 1 << 30))
+            ring.push(frame, v)
+            model.push(frame, v)
+        elif op < 7 and model.items:  # re-push an existing frame (replace)
+            f = model.items[int(rng.integers(0, len(model.items)))][0]
+            v = int(rng.integers(0, 1 << 30))
+            ring.push(f, v)
+            model.push(f, v)
+            frame = f
+        elif op < 8 and model.items:  # confirm some stored frame
+            f = model.items[int(rng.integers(0, len(model.items)))][0]
+            ring.confirm(f)
+            model.confirm(f)
+        elif op < 9 and model.items:  # rollback to a stored frame
+            f = model.items[int(rng.integers(0, len(model.items)))][0]
+            assert ring.rollback(f) == model.rollback(f)
+            frame = f
+        else:  # rollback to a missing frame: both must fail and empty
+            f = int(np.int32(frame + 1000))
+            with pytest.raises(MissingSnapshotError):
+                ring.rollback(f)
+            with pytest.raises(KeyError):
+                model.rollback(f)
+        assert ring.frames() == model.frames(), f"divergence after op {op}"
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_input_queue_matches_model(seed):
+    rng = np.random.default_rng(100 + seed)
+    q = InputQueue(input_shape=(), input_dtype=np.uint8, delay=0)
+    inputs = {}  # frame -> value (spec model)
+    served = {}  # frame -> predicted value we handed out
+    first_incorrect = None
+    cursor = 0
+    for _ in range(3000):
+        op = rng.integers(0, 10)
+        if op < 4:  # serve a read at/ahead of the cursor
+            f = cursor + int(rng.integers(0, 6))
+            v, st = q.input_for(f)
+            if f in inputs:
+                assert st == InputStatus.CONFIRMED and int(v) == inputs[f]
+            else:
+                assert st == InputStatus.PREDICTED
+                # PredictRepeatLast: nearest stored frame at/below f, else 0
+                below = [g for g in inputs if g <= f]
+                expect = inputs[max(below)] if below else 0
+                assert int(v) == expect
+                served[f] = int(v)
+            cursor = max(cursor, f)
+        elif op < 9:  # a (possibly redundant) input arrives in order
+            nxt = max(inputs) + 1 if inputs else 0
+            f = int(rng.integers(max(nxt - 3, 0), nxt + 1))  # redundancy
+            val = int(rng.integers(0, 4))
+            q.add_remote(f, np.uint8(val))
+            if f >= nxt:  # model: only new frames accepted
+                inputs[f] = val
+                if f in served and served[f] != val:
+                    if first_incorrect is None or f < first_incorrect:
+                        first_incorrect = f
+                served.pop(f, None)
+        else:  # take/compare first incorrect
+            got = q.take_first_incorrect()
+            expect = NULL_FRAME if first_incorrect is None else first_incorrect
+            assert got == expect
+            first_incorrect = None
+    assert q.last_confirmed == (max(inputs) if inputs else NULL_FRAME)
