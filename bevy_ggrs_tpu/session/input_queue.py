@@ -39,6 +39,7 @@ class InputQueue:
         self.last_confirmed = NULL_FRAME  # newest frame with a real input
         self._predictions: Dict[int, np.ndarray] = {}  # frame -> served guess
         self.first_incorrect = NULL_FRAME
+        self._base: int | None = None  # first frame of the stream, if known
 
     def default_input(self) -> np.ndarray:
         return np.zeros(self.input_shape, self.input_dtype)
@@ -58,16 +59,41 @@ class InputQueue:
         self._store(frame, np.asarray(value, self.input_dtype).reshape(self.input_shape))
 
     def _store(self, frame: int, value: np.ndarray) -> None:
-        if frame_le(frame, self.last_confirmed) and self.last_confirmed != NULL_FRAME:
-            return  # duplicate / out-of-order redundancy
+        if self.last_confirmed != NULL_FRAME and frame_le(frame, self.last_confirmed):
+            return  # duplicate / redundancy (contiguity => already stored)
+        if frame in self._inputs:
+            return
         self._inputs[frame] = value
-        self.last_confirmed = frame
+        # last_confirmed is the CONTIGUOUS high-water mark (anchored at the
+        # stream base when known, else the first frame stored); out-of-order
+        # arrivals (a lost chunk refilled later) park above it until the gap
+        # closes
+        if self.last_confirmed == NULL_FRAME:
+            if self._base is not None and frame != self._base:
+                return self._recheck_contig()  # parked until the base arrives
+            self.last_confirmed = frame
+        self._recheck_contig()
         served = self._predictions.pop(frame, None)
         if served is not None and not np.array_equal(served, value):
             if self.first_incorrect == NULL_FRAME or frame_lt(
                 frame, self.first_incorrect
             ):
                 self.first_incorrect = frame
+
+    def set_base(self, base: int) -> None:
+        """Anchor the contiguity mark at the sender's first-ever frame."""
+        self._base = base
+        self._recheck_contig()
+
+    def _recheck_contig(self) -> None:
+        from ..utils.frames import frame_add
+
+        if self.last_confirmed == NULL_FRAME and self._base is not None \
+                and self._base in self._inputs:
+            self.last_confirmed = self._base
+        while self.last_confirmed != NULL_FRAME and \
+                frame_add(self.last_confirmed, 1) in self._inputs:
+            self.last_confirmed = frame_add(self.last_confirmed, 1)
 
     # -- reading ------------------------------------------------------------
 

@@ -104,6 +104,7 @@ class P2PSession:
             )
             ep.on_input = self._make_on_input(addr)
             ep.on_checksum = self._make_on_checksum(addr)
+            ep.on_stream_base = self._make_on_stream_base(addr)
             self.endpoints[addr] = ep
         # spectator endpoints: we stream all-player confirmed inputs to them
         self.spectator_endpoints: Dict[Any, PeerEndpoint] = {}
@@ -206,6 +207,13 @@ class P2PSession:
                     self.input_shape
                 )
                 self.queues[h].add_remote(frame, value)
+
+        return cb
+
+    def _make_on_stream_base(self, addr):
+        def cb(base: int) -> None:
+            for h in self._handle_of_addr[addr]:
+                self.queues[h].set_base(base)
 
         return cb
 

@@ -13,7 +13,10 @@ in native/ggrs_core — keep in sync with message.h):
     SYNC_REQ   nonce:u32
     SYNC_REP   nonce:u32
     INPUT      start_frame:i32 count:u16 ack_frame:i32 advantage:i8
-               payload: count * input_size bytes
+               stream_base:i32 payload: count * input_size bytes
+               (stream_base = sender's first-ever input frame: lets a
+               receiver anchor its contiguous-ack mark even if the earliest
+               packets were lost)
     INPUT_ACK  ack_frame:i32
     QUAL_REQ   ping_ts_us:u64 advantage:i8
     QUAL_REP   pong_ts_us:u64
@@ -55,7 +58,7 @@ T_CHECKSUM = 8
 
 S_SYNC_REQ = struct.Struct("<I")
 S_SYNC_REP = struct.Struct("<I")
-S_INPUT = struct.Struct("<iHib")
+S_INPUT = struct.Struct("<iHibi")
 S_INPUT_ACK = struct.Struct("<i")
 S_QUAL_REQ = struct.Struct("<Qb")
 S_QUAL_REP = struct.Struct("<Q")
@@ -106,8 +109,15 @@ class PeerEndpoint:
         self.time_sync = TimeSync()
         # input plumbing (frames are EFFECTIVE frames, delay already applied)
         self.last_acked = NULL_FRAME  # newest of our inputs the peer has
-        self.last_received_frame = NULL_FRAME  # newest peer input we have
+        self.last_received_frame = NULL_FRAME  # newest peer input we have (max)
+        # highest CONTIGUOUSLY received frame — what we ack (acking the max
+        # across a chunk-loss gap would stop the sender refilling the gap)
+        self.contig_received = NULL_FRAME
+        self._contig_anchored = False  # contig holds a real value (it can
+        # legitimately be -1 when the peer's stream starts at frame 0)
+        self.stream_base = None  # first frame of OUR outbound input stream
         self.on_input: Optional[Callable[[int, bytes], None]] = None
+        self.on_stream_base: Optional[Callable[[int], None]] = None
         self.on_checksum: Optional[Callable[[int, int], None]] = None
         self.local_advantage = 0  # set by session before poll
         # stats
@@ -130,6 +140,8 @@ class PeerEndpoint:
         is an ascending [(effective_frame, raw_bytes)] list.  Chunking (up to
         4 packets per call) keeps slow receivers — late-joining or lossy
         spectators — from ever seeing a truncation gap they cannot fill."""
+        if self.stream_base is None and pending:
+            self.stream_base = pending[0][0]
         pending = [p for p in pending if frame_gt(p[0], self.last_acked)]
         self.send_queue_len = len(pending)
         if not pending:
@@ -138,14 +150,15 @@ class PeerEndpoint:
                        MAX_INPUTS_PER_PACKET):
             chunk = pending[c:c + MAX_INPUTS_PER_PACKET]
             body = S_INPUT.pack(
-                chunk[0][0], len(chunk), self.last_received_frame,
+                chunk[0][0], len(chunk), self.contig_received,
                 int(np.clip(self.local_advantage, -127, 127)),
+                self.stream_base,
             )
             body += b"".join(p[1] for p in chunk)
             self._send(T_INPUT, body)
 
     def send_input_ack(self) -> None:
-        self._send(T_INPUT_ACK, S_INPUT_ACK.pack(self.last_received_frame))
+        self._send(T_INPUT_ACK, S_INPUT_ACK.pack(self.contig_received))
 
     def send_checksum(self, frame: int, checksum: int) -> None:
         self._send(T_CHECKSUM, S_CHECKSUM.pack(frame, checksum & (2**64 - 1)))
@@ -194,22 +207,40 @@ class PeerEndpoint:
                     self._last_sync_sent = now_s()
                     self._send(T_SYNC_REQ, S_SYNC_REQ.pack(self._sync_nonce))
         elif t == T_INPUT:
-            start, count, ack, adv = S_INPUT.unpack_from(body)
+            start, count, ack, adv, base = S_INPUT.unpack_from(body)
             self._note_ack(ack)
             self.time_sync.note_remote(adv)
             self.remote_advantage = adv
+            if not self._contig_anchored:
+                # anchor just below the peer's first-ever frame so only
+                # ranges connected to the true stream start advance the ack
+                self._contig_anchored = True
+                self.contig_received = base - 1
+                if self.on_stream_base:
+                    self.on_stream_base(base)
             payload = body[S_INPUT.size:]
+            end = NULL_FRAME
             for i in range(count):
                 f = start + i
                 raw = payload[i * self.input_size:(i + 1) * self.input_size]
                 if len(raw) < self.input_size:
                     break
-                if self.last_received_frame == NULL_FRAME or frame_gt(
-                    f, self.last_received_frame
-                ):
-                    self.last_received_frame = f
+                end = f
+                if frame_gt(f, self.contig_received):
+                    if self.last_received_frame == NULL_FRAME or frame_gt(
+                        f, self.last_received_frame
+                    ):
+                        self.last_received_frame = f
                     if self.on_input:
                         self.on_input(f, raw)
+            # packets are contiguous ranges: extend the contiguous mark only
+            # if this range connects to it
+            if (
+                end != NULL_FRAME
+                and not frame_gt(start, self.contig_received + 1)
+                and frame_gt(end, self.contig_received)
+            ):
+                self.contig_received = end
         elif t == T_INPUT_ACK:
             (ack,) = S_INPUT_ACK.unpack_from(body)
             self._note_ack(ack)

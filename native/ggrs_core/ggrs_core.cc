@@ -167,7 +167,14 @@ struct Endpoint {
   bool interrupted = false, disconnected = false;
   TimeSync time_sync;
   Frame last_acked = NULL_FRAME;        /* newest of OUR inputs peer has */
-  Frame last_received_frame = NULL_FRAME; /* newest peer input we have */
+  Frame last_received_frame = NULL_FRAME; /* newest peer input we have (max) */
+  /* highest CONTIGUOUSLY received frame — what we ack (see protocol.py);
+   * anchored flag is separate: contig can legitimately equal -1 (== the
+   * NULL sentinel) when the peer stream starts at frame 0 */
+  Frame contig_received = NULL_FRAME;
+  bool contig_anchored = false;
+  bool have_stream_base = false;
+  Frame stream_base = NULL_FRAME;       /* first frame of OUR outbound stream */
   int local_advantage = 0, remote_advantage = 0;
   double ping_s = 0;
   uint64_t bytes_sent = 0;
@@ -176,6 +183,8 @@ struct Endpoint {
   /* inbound inputs + checksums, drained by the session */
   std::vector<std::pair<Frame, std::vector<uint8_t>>> inbox;
   std::vector<std::pair<Frame, uint64_t>> checksum_inbox;
+  Frame base_inbox = NULL_FRAME;  /* peer stream base, delivered once */
+  bool have_base_inbox = false;
 
   void init(double now) { last_recv = now; created = now; }
 
@@ -197,6 +206,10 @@ struct Endpoint {
   void send_inputs(const std::deque<std::pair<Frame, std::vector<uint8_t>>> &pending) {
     /* redundant packets, chunked: slow receivers (late spectators) must
      * never see a truncation gap they cannot fill */
+    if (!have_stream_base && !pending.empty()) {
+      have_stream_base = true;
+      stream_base = pending.front().first;
+    }
     std::vector<const std::pair<Frame, std::vector<uint8_t>> *> out;
     for (auto &p : pending)
       if (last_acked == NULL_FRAME || frame_gt(p.first, last_acked)) out.push_back(&p);
@@ -208,15 +221,16 @@ struct Endpoint {
       Writer b;
       b.i32(out[c]->first);
       b.u16((uint16_t)(end - c));
-      b.i32(last_received_frame);
+      b.i32(contig_received);
       int adv = local_advantage; if (adv > 127) adv = 127; if (adv < -127) adv = -127;
       b.i8((int8_t)adv);
+      b.i32(stream_base);
       for (size_t i = c; i < end; i++) b.bytes(out[i]->second.data(), out[i]->second.size());
       send(T_INPUT, b);
     }
   }
 
-  void send_input_ack() { Writer b; b.i32(last_received_frame); send(T_INPUT_ACK, b); }
+  void send_input_ack() { Writer b; b.i32(contig_received); send(T_INPUT_ACK, b); }
 
   void send_checksum(Frame f, uint64_t cs) {
     Writer b; b.i32(f); b.u64(cs); send(T_CHECKSUM, b);
@@ -263,20 +277,34 @@ struct Endpoint {
         uint16_t count = r.u16();
         Frame ack = r.i32();
         int8_t adv = r.i8();
+        Frame base = r.i32();
         if (!r.ok) break;
         note_ack(ack);
         time_sync.note_remote(adv);
         remote_advantage = adv;
+        if (!contig_anchored) {
+          contig_anchored = true;
+          contig_received = base - 1;  /* anchor at the true stream start */
+          base_inbox = base;
+          have_base_inbox = true;
+        }
+        Frame end = NULL_FRAME;
         for (int i = 0; i < count; i++) {
           Frame f = start + i;
           if (!r.need(input_size)) break;
           const uint8_t *raw = r.p + r.off;
           r.off += input_size;
-          if (last_received_frame == NULL_FRAME || frame_gt(f, last_received_frame)) {
-            last_received_frame = f;
+          end = f;
+          if (frame_gt(f, contig_received)) {
+            if (last_received_frame == NULL_FRAME || frame_gt(f, last_received_frame))
+              last_received_frame = f;
             inbox.emplace_back(f, std::vector<uint8_t>(raw, raw + input_size));
           }
         }
+        /* contiguous ranges only extend the mark when they connect to it */
+        if (end != NULL_FRAME && !frame_gt(start, contig_received + 1) &&
+            frame_gt(end, contig_received))
+          contig_received = end;
         break;
       }
       case T_INPUT_ACK: {
@@ -352,6 +380,22 @@ struct InputQueue {
 
   std::vector<uint8_t> def() const { return std::vector<uint8_t>(input_size, 0); }
 
+  bool have_base = false;
+  Frame base = NULL_FRAME;
+
+  void set_base(Frame b) {
+    have_base = true;
+    base = b;
+    recheck_contig();
+  }
+
+  void recheck_contig() {
+    if (last_confirmed == NULL_FRAME && have_base && inputs.count(base))
+      last_confirmed = base;
+    while (last_confirmed != NULL_FRAME && inputs.count(last_confirmed + 1))
+      last_confirmed = last_confirmed + 1;
+  }
+
   Frame add_local(Frame frame, const uint8_t *v) {
     Frame eff = frame + delay;
     store(eff, v);
@@ -361,6 +405,7 @@ struct InputQueue {
 
   void store(Frame frame, const uint8_t *v) {
     if (last_confirmed != NULL_FRAME && frame_le(frame, last_confirmed)) return;
+    if (inputs.count(frame)) return;
     std::vector<uint8_t> val(v, v + input_size);
     auto it = predictions.find(frame);
     if (it != predictions.end()) {
@@ -370,7 +415,12 @@ struct InputQueue {
       predictions.erase(it);
     }
     inputs[frame] = std::move(val);
-    last_confirmed = frame;
+    /* contiguous high-water mark, anchored at the stream base when known */
+    if (last_confirmed == NULL_FRAME) {
+      if (have_base && frame != base) { recheck_contig(); return; }
+      last_confirmed = frame;
+    }
+    recheck_contig();
   }
 
   /* returns status */
@@ -555,6 +605,11 @@ void ggrs_p2p_poll(GgrsP2P *s) {
     /* drain endpoint state into the session */
     for (auto &e : ep->events) s->events.push_back(e);
     ep->events.clear();
+    if (ep->have_base_inbox) {
+      ep->have_base_inbox = false;
+      for (int h : s->handles_of_addr[addr])
+        s->queues[h].set_base(ep->base_inbox);
+    }
     for (auto &[f, raw] : ep->inbox) {
       auto &handles = s->handles_of_addr[addr];
       for (size_t i = 0; i < handles.size(); i++)

@@ -16,7 +16,9 @@ from bevy_ggrs_tpu.session.events import (
 from bevy_ggrs_tpu.session.protocol import (
     HDR,
     MAGIC,
+    MAX_INPUTS_PER_PACKET,
     PeerEndpoint,
+    S_INPUT,
     T_CHECKSUM,
     T_KEEP_ALIVE,
 )
@@ -135,6 +137,63 @@ def test_truncated_input_payload_safe():
     # claim 3 inputs but ship bytes for 1.5
     from bevy_ggrs_tpu.session.protocol import S_INPUT
 
-    body = S_INPUT.pack(0, 3, -1, 0) + b"\x01\x02\x03\x04\x05\x06"
+    body = S_INPUT.pack(0, 3, -1, 0, 0) + b"\x01\x02\x03\x04\x05\x06"
     b.handle(HDR.pack(MAGIC, 3) + body)
     assert got == [(0, b"\x01\x02\x03\x04")]  # only the complete one
+
+
+def test_chunk_loss_gap_refills():
+    # >64 pending inputs -> 2 chunks; losing chunk 1 must NOT let the ack
+    # leapfrog the gap: the receiver acks the contiguous mark, the sender
+    # retransmits, and the gap fills
+    a, b, ao, bo = make_pair()
+    pump(a, b, ao, bo)
+    got = {}
+    b.on_input = lambda f, raw: got.setdefault(f, raw)
+    n = MAX_INPUTS_PER_PACKET + 20
+    pending = [(f, bytes([f % 251])) for f in range(n)]
+    a.send_inputs(pending)
+    packets = list(ao)
+    ao.clear()
+    assert len(packets) == 2
+    b.handle(packets[1])  # chunk 1 lost; only chunk 2 arrives
+    assert b.contig_received == -1  # gap: nothing contiguous yet
+    b.send_input_ack()
+    for pkt in bo:
+        a.handle(pkt)
+    bo.clear()
+    assert a.last_acked == -1  # sender knows nothing landed contiguously
+    # retransmission fills the gap
+    a.send_inputs(pending)
+    for pkt in ao:
+        b.handle(pkt)
+    ao.clear()
+    assert sorted(got) == list(range(n))
+    assert b.contig_received == n - 1
+    b.send_input_ack()
+    for pkt in bo:
+        a.handle(pkt)
+    assert a.last_acked == n - 1
+
+
+def test_first_packets_lost_anchors_at_stream_base():
+    # even if the receiver's FIRST seen packet is beyond the stream start,
+    # the stream_base field keeps the ack anchored before the gap
+    a, b, ao, bo = make_pair()
+    pump(a, b, ao, bo)
+    got = {}
+    b.on_input = lambda f, raw: got.setdefault(f, raw)
+    bases = []
+    b.on_stream_base = bases.append
+    n = MAX_INPUTS_PER_PACKET + 10
+    pending = [(f + 5, bytes([f % 251])) for f in range(n)]  # stream starts at 5
+    a.send_inputs(pending)
+    packets = list(ao)
+    ao.clear()
+    b.handle(packets[1])  # first chunk lost entirely
+    assert bases == [5]
+    assert b.contig_received == 4  # anchored just below the true base
+    a.send_inputs(pending)
+    for pkt in ao:
+        b.handle(pkt)
+    assert b.contig_received == 5 + n - 1
