@@ -12,7 +12,7 @@ from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
 DT = 1.0 / 60.0
 
 
-@pytest.mark.parametrize("loss,latency", [(0.15, 1), (0.05, 3)])
+@pytest.mark.parametrize("loss,latency", [(0.15, 1), (0.05, 3), (0.3, 2)])
 def test_lossy_network_stays_in_sync(loss, latency):
     net = ChannelNetwork(latency_hops=latency, loss=loss, seed=42)
     socks = [net.endpoint("a"), net.endpoint("b")]
