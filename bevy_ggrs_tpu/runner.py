@@ -115,6 +115,19 @@ class GgrsRunner:
             self._step_session()
             fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
 
+    def read_components(self, names=None) -> dict:
+        """Fetch component columns (and the active mask) to host numpy in one
+        transfer — the render-readback path.  ``names=None`` fetches all."""
+        import jax
+
+        from .snapshot.world import active_mask
+
+        names = list(names) if names is not None else list(self.app.reg.components)
+        arrays = {n: self.world.comps[n] for n in names}
+        arrays["__active__"] = active_mask(self.world)
+        out = jax.device_get(arrays)
+        return {k: np.asarray(v) for k, v in out.items()}
+
     def stats(self) -> dict:
         """Driver health counters (rollback frequency/depth, dispatches,
         stalls, speculation hit rate)."""
