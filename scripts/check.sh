@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-command CI: native build, full test suite, bench + graft smoke.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C native
+
+echo "== tests (CPU, 8 virtual devices) =="
+python -m pytest tests/ -q
+
+echo "== graft entry (CPU) =="
+BGT_PLATFORM=cpu BGT_CPU_DEVICES=8 python - <<'EOF'
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+apply_platform_env()
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+jax.block_until_ready(jax.jit(fn)(*args))
+g.dryrun_multichip(8)
+print("graft ok")
+EOF
+
+echo "== bench =="
+python bench.py
+
+echo "ALL CHECKS PASSED"
