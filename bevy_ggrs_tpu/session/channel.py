@@ -8,23 +8,25 @@ real sockets."""
 from __future__ import annotations
 
 import random
-from collections import deque
 from typing import Any, Deque, Dict, List, Tuple
 
 
 class ChannelNetwork:
-    """A little virtual packet network: named endpoints, FIFO per pair,
-    optional per-hop latency (in ``deliver`` calls) and loss rate."""
+    """A little virtual packet network: named endpoints, optional per-hop
+    latency (in ``deliver`` calls), loss rate, and reorder jitter (extra
+    random hops per packet -> out-of-order delivery)."""
 
-    def __init__(self, latency_hops: int = 0, loss: float = 0.0, seed: int = 0):
+    def __init__(self, latency_hops: int = 0, loss: float = 0.0, seed: int = 0,
+                 jitter_hops: int = 0):
         self.latency_hops = latency_hops
         self.loss = loss
+        self.jitter_hops = jitter_hops
         self._rng = random.Random(seed)
-        self._queues: Dict[Any, Deque[Tuple[int, Any, bytes]]] = {}
+        self._queues: Dict[Any, list] = {}
         self._clock = 0
 
     def endpoint(self, name: Any) -> "ChannelSocket":
-        self._queues.setdefault(name, deque())
+        self._queues.setdefault(name, [])
         return ChannelSocket(self, name)
 
     def deliver(self) -> None:
@@ -34,16 +36,17 @@ class ChannelNetwork:
     def _send(self, src: Any, dst: Any, data: bytes) -> None:
         if self.loss and self._rng.random() < self.loss:
             return
-        q = self._queues.setdefault(dst, deque())
-        q.append((self._clock + self.latency_hops, src, data))
+        delay = self.latency_hops
+        if self.jitter_hops:
+            delay += self._rng.randint(0, self.jitter_hops)
+        q = self._queues.setdefault(dst, [])
+        q.append((self._clock + delay, src, data))
 
     def _recv_all(self, name: Any) -> List[Tuple[Any, bytes]]:
-        q = self._queues.setdefault(name, deque())
-        out = []
-        while q and q[0][0] <= self._clock:
-            _, src, data = q.popleft()
-            out.append((src, data))
-        return out
+        q = self._queues.setdefault(name, [])
+        due = [(t, src, d) for (t, src, d) in q if t <= self._clock]
+        q[:] = [(t, src, d) for (t, src, d) in q if t > self._clock]
+        return [(src, d) for (_, src, d) in due]
 
 
 class ChannelSocket:

@@ -115,3 +115,34 @@ def test_input_queue_matches_model(seed):
             assert got == expect
             first_incorrect = None
     assert q.last_confirmed == (max(inputs) if inputs else NULL_FRAME)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_input_queue_out_of_order_matches_model(seed):
+    """Out-of-order arrivals (reordered/refilled chunks): last_confirmed must
+    be the CONTIGUOUS high-water mark anchored at the stream base."""
+    rng = np.random.default_rng(200 + seed)
+    base = int(rng.integers(0, 5))
+    q = InputQueue(input_shape=(), input_dtype=np.uint8)
+    q.set_base(base)
+    truth = {}  # frame -> value, arrival in any order
+    pending = list(rng.permutation(np.arange(base, base + 60)))
+    while pending:
+        # deliver a random prefix chunk (simulates packet ranges landing oo)
+        take = int(rng.integers(1, 5))
+        for _ in range(min(take, len(pending))):
+            f = int(pending.pop())
+            v = int(rng.integers(0, 7))
+            q.add_remote(f, np.uint8(v))
+            truth.setdefault(f, v)
+        # model: contiguous mark from base
+        lc = base - 1
+        while lc + 1 in truth:
+            lc += 1
+        expect = lc if lc >= base else -1
+        assert q.last_confirmed == expect
+    # everything delivered: fully contiguous
+    assert q.last_confirmed == base + 59
+    for f, v in truth.items():
+        got = q.confirmed_input(f)
+        assert got is not None and int(got) == v

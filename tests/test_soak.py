@@ -12,9 +12,9 @@ from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
 DT = 1.0 / 60.0
 
 
-@pytest.mark.parametrize("loss,latency", [(0.15, 1), (0.05, 3), (0.3, 2)])
-def test_lossy_network_stays_in_sync(loss, latency):
-    net = ChannelNetwork(latency_hops=latency, loss=loss, seed=42)
+@pytest.mark.parametrize("loss,latency,jitter", [(0.15, 1, 0), (0.05, 3, 0), (0.3, 2, 0), (0.1, 1, 4)])
+def test_lossy_network_stays_in_sync(loss, latency, jitter):
+    net = ChannelNetwork(latency_hops=latency, loss=loss, seed=42, jitter_hops=jitter)
     socks = [net.endpoint("a"), net.endpoint("b")]
     rngs = [np.random.default_rng(100 + i) for i in range(2)]
     runners = []
@@ -75,4 +75,4 @@ def test_lossy_network_stays_in_sync(loss, latency):
     assert f is not None, "no shared confirmed frame found"
     assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
         runners[1].ring.peek(f)[1]
-    ), f"desync at confirmed frame {f} under loss={loss} latency={latency}"
+    ), f"desync at confirmed frame {f} under loss={loss} latency={latency} jitter={jitter}"
