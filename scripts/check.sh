@@ -3,8 +3,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== native build =="
+echo "== native build + tests =="
 make -C native
+make -C native test
 
 echo "== tests (CPU, 8 virtual devices) =="
 python -m pytest tests/ -q

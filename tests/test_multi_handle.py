@@ -1,0 +1,96 @@
+"""Multiple local players per peer: a 4-player game across 2 connections
+(each endpoint streams 2 input rows per frame).  The reference supports 2-4
+players with any local/remote split (box_game.rs:34-38)."""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_two_local_players_per_peer(native):
+    if native:
+        from bevy_ggrs_tpu.session.native import native_available
+
+        if not native_available():
+            pytest.skip("native core not built")
+        import socket as so
+
+        ports = []
+        for _ in range(2):
+            s = so.socket(so.AF_INET, so.SOCK_DGRAM)
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+    else:
+        net = ChannelNetwork()
+        socks = [net.endpoint("A"), net.endpoint("B")]
+
+    keys = [box_game.keys_to_input(right=True), box_game.keys_to_input(up=True),
+            box_game.keys_to_input(left=True), box_game.keys_to_input(down=True)]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=4)
+        mine = [0, 1] if i == 0 else [2, 3]
+        theirs = [2, 3] if i == 0 else [0, 1]
+        b = SessionBuilder.for_app(app).with_input_delay(1)
+        for h in mine:
+            b.add_player(PlayerType.LOCAL, h)
+        for h in theirs:
+            if native:
+                b.add_player(PlayerType.REMOTE, h, ("127.0.0.1", ports[1 - i]))
+            else:
+                b.add_player(PlayerType.REMOTE, h, "BA"[i == 1])
+        if native:
+            session = b.start_p2p_session_native(local_port=ports[i])
+        else:
+            session = b.start_p2p_session(socks[i])
+        runners.append(
+            GgrsRunner(app, session,
+                       read_inputs=lambda hs: {h: keys[h] for h in hs})
+        )
+        assert sorted(session.local_player_handles()) == mine
+
+    import time
+
+    for _ in range(400):
+        if not native:
+            net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    assert all(r.session.current_state() == SessionState.RUNNING for r in runners)
+
+    for _ in range(60):
+        if not native:
+            net.deliver()
+        for r in runners:
+            r.update(DT)
+
+    # every player's held direction moved their own cube on BOTH peers
+    for r in runners:
+        pos = np.asarray(r.world.comps["pos"])
+        assert pos[0, 0] > 1.9  # p0 right (+x)
+        assert pos[2, 0] < -1.9 + 2.0  # p2 left (-x from its spawn)
+        assert r.frame >= 50
+    # and the peers agree
+    for _ in range(6):
+        shared = sorted(set(runners[0].ring.frames()) & set(runners[1].ring.frames()))
+        if shared:
+            break
+        if not native:
+            net.deliver()
+        (runners[0] if runners[0].frame <= runners[1].frame else runners[1]).update(DT)
+    assert shared
+    f = shared[-1]
+    assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
+        runners[1].ring.peek(f)[1]
+    )
