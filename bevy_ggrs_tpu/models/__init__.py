@@ -1,3 +1,3 @@
-from . import box_game, crowd, particles, stress, stress_soa, fixed_point
+from . import box_game, crowd, particles, pong, stress, stress_soa, fixed_point
 
-__all__ = ["box_game", "crowd", "particles", "stress", "stress_soa", "fixed_point"]
+__all__ = ["box_game", "crowd", "particles", "pong", "stress", "stress_soa", "fixed_point"]

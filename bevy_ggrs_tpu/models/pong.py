@@ -1,0 +1,156 @@
+"""pong — a complete two-player game on the framework.
+
+Demonstrates the full API surface working together the way a real game uses
+it: paddle entities driven by inputs, a ball that despawns on goals and
+respawns after a serve delay (deferred despawn + spawn under jit), a score
+resource, and a win condition — all rollback-safe and checksummed.  Input
+bits: UP=1, DOWN=2.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..app import App
+from ..ops.resim import StepCtx
+from ..snapshot.world import WorldState, active_mask, despawn_where, spawn, spawn_many
+
+UP, DOWN = 1, 2
+
+COURT_W = np.float32(8.0)  # half-extent x
+COURT_H = np.float32(4.5)  # half-extent y
+PADDLE_X = np.float32(7.5)
+PADDLE_HALF = np.float32(1.0)
+PADDLE_SPEED = np.float32(6.0)
+BALL_SPEED = np.float32(6.0)
+SERVE_DELAY = 45  # frames between goal and re-serve
+WIN_SCORE = 11
+
+# entity kinds
+K_PADDLE = 0
+K_BALL = 1
+
+
+def step(world: WorldState, ctx: StepCtx) -> WorldState:
+    m = active_mask(world)
+    kind = world.comps["kind"]
+    owner = world.comps["owner"]
+    pos = world.comps["pos"]
+    vel = world.comps["vel"]
+
+    is_paddle = m & (kind == K_PADDLE)
+    is_ball = m & (kind == K_BALL)
+
+    # ---- paddles: input-driven vertical movement
+    inp = ctx.inputs.reshape(-1)[jnp.clip(owner, 0, ctx.inputs.shape[0] - 1)]
+    inp = jnp.where(is_paddle, inp, 0).astype(jnp.int32)
+    dy = (((inp >> 0) & 1) - ((inp >> 1) & 1)).astype(jnp.float32) * PADDLE_SPEED
+    pad_y = jnp.clip(
+        pos[:, 1] + dy * ctx.delta_seconds,
+        -COURT_H + PADDLE_HALF, COURT_H - PADDLE_HALF,
+    )
+    pos = pos.at[:, 1].set(jnp.where(is_paddle, pad_y, pos[:, 1]))
+
+    # ---- ball: integrate, bounce off walls and paddles
+    bpos = pos + vel * ctx.delta_seconds
+    bvel = vel
+    # wall bounce (top/bottom)
+    hit_wall = jnp.abs(bpos[:, 1]) > COURT_H
+    bvel = bvel.at[:, 1].set(jnp.where(hit_wall, -bvel[:, 1], bvel[:, 1]))
+    bpos = bpos.at[:, 1].set(jnp.clip(bpos[:, 1], -COURT_H, COURT_H))
+    # paddle bounce: compare ball y against the owning side's paddle y
+    paddle_y = jnp.sum(
+        jnp.where(is_paddle & (owner == 0), pos[:, 1], 0.0)
+    ), jnp.sum(jnp.where(is_paddle & (owner == 1), pos[:, 1], 0.0))
+    p0y, p1y = paddle_y
+    near_p0 = (bpos[:, 0] < -PADDLE_X) & (jnp.abs(bpos[:, 1] - p0y) <= PADDLE_HALF)
+    near_p1 = (bpos[:, 0] > PADDLE_X) & (jnp.abs(bpos[:, 1] - p1y) <= PADDLE_HALF)
+    bounce = (near_p0 & (bvel[:, 0] < 0)) | (near_p1 & (bvel[:, 0] > 0))
+    bvel = bvel.at[:, 0].set(jnp.where(bounce, -bvel[:, 0] * 1.05, bvel[:, 0]))
+    bpos = bpos.at[:, 0].set(
+        jnp.where(bounce, jnp.clip(bpos[:, 0], -PADDLE_X, PADDLE_X), bpos[:, 0])
+    )
+
+    pos = jnp.where(is_ball[:, None], bpos, pos)
+    vel = jnp.where(is_ball[:, None], bvel, vel)
+
+    # ---- goals: ball fully past a goal line (and not bounced)
+    goal_p1 = is_ball & (pos[:, 0] <= -COURT_W)  # player 1 scores
+    goal_p0 = is_ball & (pos[:, 0] >= COURT_W)  # player 0 scores
+    scored_any = jnp.any(goal_p0) | jnp.any(goal_p1)
+    score = world.res["score"]
+    score = score.at[0].add(jnp.sum(goal_p0).astype(jnp.int32))
+    score = score.at[1].add(jnp.sum(goal_p1).astype(jnp.int32))
+    world = dataclasses.replace(
+        world,
+        comps={**world.comps, "pos": pos, "vel": vel},
+        res={**world.res, "score": score},
+    )
+    world = despawn_where(_REG[0], world, goal_p0 | goal_p1, ctx.frame)
+
+    # ---- serve: respawn the ball after the delay (deterministic direction)
+    serve_at = world.res["serve_at"]
+    serve_at = jnp.where(
+        scored_any, ctx.frame + SERVE_DELAY, serve_at
+    ).astype(jnp.int32)
+    game_over = (score[0] >= WIN_SCORE) | (score[1] >= WIN_SCORE)
+    do_serve = (serve_at == ctx.frame) & ~game_over
+    direction = jnp.where((score[0] + score[1]) % 2 == 0, 1.0, -1.0)
+    tilt = jnp.where(ctx.frame % 3 == 0, 0.35, -0.5).astype(jnp.float32)
+    new_ball = {
+        "pos": jnp.zeros((1, 2), jnp.float32),
+        "vel": jnp.stack(
+            [direction * BALL_SPEED, tilt * BALL_SPEED]
+        ).astype(jnp.float32)[None],
+        "kind": jnp.full((1,), K_BALL, jnp.int32),
+        "owner": jnp.full((1,), -1, jnp.int32),
+    }
+    world = spawn_many(
+        _REG[0], world, new_ball, count=jnp.where(do_serve, 1, 0)
+    )
+    return dataclasses.replace(
+        world, res={**world.res, "serve_at": serve_at}
+    )
+
+
+_REG = [None]  # registry handle for spawn_many inside the jitted step
+
+
+def make_app(fps: int = 60, capacity: int = 16) -> App:
+    app = App(num_players=2, capacity=capacity, fps=fps,
+              input_shape=(), input_dtype=np.uint8)
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("vel", (2,), jnp.float32, checksum=True)
+    app.rollback_component("kind", (), jnp.int32, checksum=True)
+    app.rollback_component("owner", (), jnp.int32, checksum=True)
+    app.rollback_resource("score", np.zeros(2, np.int32), checksum=True)
+    app.rollback_resource("serve_at", np.int32(1), checksum=True)
+    _REG[0] = app.reg
+    app.set_step(step)
+
+    def setup(world):
+        for h in range(2):
+            world, _ = spawn(
+                app.reg, world,
+                {"pos": np.array([(-1 if h == 0 else 1) * PADDLE_X, 0.0],
+                                 np.float32),
+                 "vel": np.zeros(2, np.float32),
+                 "kind": K_PADDLE, "owner": h},
+            )
+        return world
+
+    app.set_setup(setup)
+    return app
+
+
+def winner(world) -> int:
+    """-1 while playing, else the winning handle."""
+    s = np.asarray(world.res["score"])
+    if s[0] >= WIN_SCORE:
+        return 0
+    if s[1] >= WIN_SCORE:
+        return 1
+    return -1
