@@ -33,6 +33,7 @@ DEFAULT_FPS = 60  # /root/reference/src/lib.rs:62
 
 
 class App:
+    """Rollback application: registration surface + compiled device functions."""
     def __init__(
         self,
         num_players: int = 2,
@@ -68,6 +69,7 @@ class App:
         strategy: Strategy = CopyStrategy,
         required: bool = False,
     ) -> "App":
+        """Register a component column for snapshot/rollback (RollbackApp analog)."""
         self.reg.register_component(
             name, shape, dtype, default, checksum, hash_fn, strategy, required
         )
@@ -82,6 +84,7 @@ class App:
         present: bool = True,
         strategy: Strategy = CopyStrategy,
     ) -> "App":
+        """Register a resource pytree for snapshot/rollback."""
         self.reg.register_resource(name, init, checksum, hash_fn, present, strategy)
         return self
 
@@ -97,6 +100,7 @@ class App:
         return self
 
     def checksum_resource(self, name: str, hash_fn=None) -> "App":
+        """Enable checksumming for an already-registered resource."""
         spec = self.reg.resources[name]
         import dataclasses
 
@@ -106,6 +110,7 @@ class App:
         return self
 
     def register_hierarchy(self) -> "App":
+        """Enable the parent-link (ChildOf analog) component and recursive despawn."""
         self.reg.register_hierarchy()
         return self
 
@@ -123,6 +128,7 @@ class App:
     # -- state -------------------------------------------------------------
 
     def init_state(self) -> WorldState:
+        """Build the initial WorldState (runs the setup function if set)."""
         w = self.reg.init_state()
         if self._setup is not None:
             w = self._setup(w)
@@ -135,6 +141,7 @@ class App:
 
     @property
     def step(self):
+        """The registered step function (raises if set_step was never called)."""
         if self._step is None:
             raise RuntimeError("App.set_step was never called")
         return self._step
@@ -157,6 +164,7 @@ class App:
 
     @cached_property
     def checksum_fn(self):
+        """jit-compiled world checksum -> uint32[2]."""
         import jax
 
         return jax.jit(lambda w: world_checksum(self.reg, w))

@@ -32,6 +32,7 @@ ARENA_HALF = np.float32(4.0)
 
 
 def step(world: WorldState, ctx: StepCtx) -> WorldState:
+    """Ice-rink cube physics: input acceleration, friction, clamped arena."""
     handle = world.comps["handle"].astype(jnp.int32)
     mask = active_mask(world) & world.has["handle"]
     # gather this entity's input byte by player handle
@@ -89,6 +90,7 @@ def setup(app: App):
 
 
 def make_app(num_players: int = 2, capacity: int = 8, fps: int = 60) -> App:
+    """Build the box_game App (pos/vel/handle columns, checksummed)."""
     app = App(
         num_players=num_players,
         capacity=capacity,

@@ -32,6 +32,7 @@ BOUND = np.float32(30.0)
 
 
 def make_step(app: App, num_teams: int):
+    """Build the flocking step (team centroids via one-hot matmul)."""
     def step(world: WorldState, ctx: StepCtx) -> WorldState:
         m = active_mask(world) & world.has["team"]
         mf = m.astype(jnp.float32)
@@ -78,6 +79,7 @@ def make_step(app: App, num_teams: int):
 
 def make_app(n_per_team: int = 512, num_teams: int = 2, capacity: int | None = None,
              fps: int = 60, seed: int = 0) -> App:
+    """Build the crowd App: n_per_team boids per player-controlled team."""
     n = n_per_team * num_teams
     capacity = capacity or n
     app = App(num_players=num_teams, capacity=capacity, fps=fps,

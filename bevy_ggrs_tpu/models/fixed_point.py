@@ -35,6 +35,7 @@ def _q_mul(a, b):
 
 
 def step(world: WorldState, ctx: StepCtx) -> WorldState:
+    """Q16.16 integer box_game step (bit-identical across backends)."""
     handle = world.comps["handle"]
     mask = active_mask(world) & world.has["handle"]
     inp = ctx.inputs.reshape(-1)[jnp.clip(handle, 0, ctx.inputs.shape[0] - 1)]
@@ -65,6 +66,7 @@ def step(world: WorldState, ctx: StepCtx) -> WorldState:
 
 
 def make_app(num_players: int = 2, capacity: int = 8, fps: int = 60) -> App:
+    """Build the fixed-point App (int32 pos/vel in Q16.16)."""
     app = App(num_players=num_players, capacity=capacity, fps=fps,
               input_shape=(), input_dtype=np.uint8)
     app.rollback_component("pos", (2,), jnp.int32, checksum=True)

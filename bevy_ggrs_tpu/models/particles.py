@@ -31,6 +31,7 @@ DEFAULT_TTL = 120  # frames (2 s at 60 fps, particles.rs ttl)
 
 
 def make_step(app: App, rate: int, ttl: int = DEFAULT_TTL):
+    """Build the particles step: ttl decay, gravity, seeded spawn bursts."""
     reg = app.reg
 
     def step(world: WorldState, ctx: StepCtx) -> WorldState:
@@ -90,6 +91,7 @@ def make_app(
     checksum: bool = True,
     seed: int = 0,
 ) -> App:
+    """Build the particles stress App (capacity sized for rate x ttl)."""
     if capacity is None:
         capacity = rate * (ttl + 8) + 64  # steady state + rollback headroom
     app = App(

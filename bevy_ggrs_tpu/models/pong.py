@@ -35,6 +35,7 @@ K_BALL = 1
 
 
 def step(world: WorldState, ctx: StepCtx) -> WorldState:
+    """Paddles + ball + goals + serve cycle (see module docstring)."""
     m = active_mask(world)
     kind = world.comps["kind"]
     owner = world.comps["owner"]
@@ -120,6 +121,7 @@ _REG = [None]  # registry handle for spawn_many inside the jitted step
 
 
 def make_app(fps: int = 60, capacity: int = 16) -> App:
+    """Build the pong App (paddle entities, score/serve resources)."""
     app = App(num_players=2, capacity=capacity, fps=fps,
               input_shape=(), input_dtype=np.uint8)
     app.rollback_component("pos", (2,), jnp.float32, checksum=True)

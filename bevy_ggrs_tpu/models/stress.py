@@ -18,6 +18,7 @@ BOUND = np.float32(50.0)
 
 
 def step(world, ctx):
+    """Gravity integration with elastic arena bounces ([N,3] columns)."""
     m = active_mask(world)[:, None]
     vel = world.comps["vel"] + jnp.array([0.0, GRAVITY, 0.0]) * ctx.delta_seconds
     pos = world.comps["pos"] + vel * ctx.delta_seconds
@@ -36,6 +37,7 @@ def step(world, ctx):
 
 def make_app(n_entities: int = 10_000, capacity: int | None = None, fps: int = 60,
              checksum: bool = True, seed: int = 0, num_players: int = 2) -> App:
+    """Build the benchmark workload App with n_entities pre-spawned."""
     capacity = capacity or n_entities
     app = App(num_players=num_players, capacity=capacity, fps=fps,
               input_shape=(), input_dtype=np.uint8, seed=seed)

@@ -24,6 +24,7 @@ _COLS = ("x", "y", "z", "vx", "vy", "vz")
 
 
 def step(world, ctx):
+    """Same physics as stress.step over per-coordinate scalar columns."""
     m = active_mask(world)
     dt = ctx.delta_seconds
     c = world.comps
@@ -45,6 +46,7 @@ def step(world, ctx):
 
 def make_app(n_entities: int = 10_000, capacity: int | None = None,
              fps: int = 60, checksum: bool = True, seed: int = 0) -> App:
+    """Build the scalar-column benchmark App with n_entities pre-spawned."""
     capacity = capacity or n_entities
     app = App(num_players=2, capacity=capacity, fps=fps,
               input_shape=(), input_dtype=np.uint8, seed=seed)

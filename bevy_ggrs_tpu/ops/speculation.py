@@ -43,6 +43,7 @@ class SpeculationConfig:
 
 
 class SpeculationCache:
+    """Branch cache: speculated (start_frame, inputs) -> per-frame states + checksums."""
     def __init__(self, app, config: SpeculationConfig):
         self.app = app
         self.config = config
@@ -125,6 +126,7 @@ class SpeculationCache:
 
 
 def jax_tree_slice(tree, idx):
+    """tree_map(a[idx]) over a stacked pytree."""
     import jax
 
     return jax.tree.map(lambda a: a[idx], tree)

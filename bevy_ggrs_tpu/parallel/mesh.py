@@ -34,6 +34,7 @@ SPEC_AXIS = "spec"
 def make_mesh(
     n_data: Optional[int] = None, n_spec: int = 1, devices=None
 ) -> Mesh:
+    """Build a (data x spec) device mesh from the available devices."""
     devices = devices if devices is not None else jax.devices()
     if n_data is None:
         n_data = len(devices) // n_spec

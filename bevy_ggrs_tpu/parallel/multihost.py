@@ -77,8 +77,10 @@ def make_multihost_mesh(n_spec: int = 1) -> Mesh:
 
 
 def process_count() -> int:
+    """jax.process_count passthrough."""
     return jax.process_count()
 
 
 def is_primary() -> bool:
+    """True on process 0 (the coordinating host)."""
     return jax.process_index() == 0

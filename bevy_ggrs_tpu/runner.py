@@ -37,6 +37,7 @@ from .utils.tracing import span, trace_log
 
 
 class GgrsRunner:
+    """The schedule driver: fixed-timestep loop, session stepping, fused request dispatch (see module docstring)."""
     def __init__(
         self,
         app: App,

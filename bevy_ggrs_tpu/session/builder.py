@@ -16,6 +16,7 @@ from .synctest import SyncTestSession
 
 
 class SessionBuilder:
+    """Fluent session construction (see module docstring for the surface)."""
     def __init__(self, input_shape: Tuple[int, ...] = (), input_dtype=np.uint8):
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
@@ -31,29 +32,35 @@ class SessionBuilder:
 
     @classmethod
     def for_app(cls, app) -> "SessionBuilder":
+        """Builder pre-filled with the app's input spec and player count."""
         b = cls(app.input_shape, app.input_dtype)
         b._num_players = app.num_players
         return b
 
     def with_num_players(self, n: int) -> "SessionBuilder":
+        """Set the total player count (handles 0..n-1)."""
         if n < 1:
             raise InvalidRequestError("num_players must be >= 1")
         self._num_players = n
         return self
 
     def with_max_prediction_window(self, n: int) -> "SessionBuilder":
+        """Frames the session may run ahead of confirmed inputs before stalling."""
         self._max_prediction = n
         return self
 
     def with_input_delay(self, n: int) -> "SessionBuilder":
+        """Frames of local input delay (trades latency for fewer rollbacks)."""
         self._input_delay = n
         return self
 
     def with_check_distance(self, n: int) -> "SessionBuilder":
+        """SyncTest resimulation depth per tick."""
         self._check_distance = n
         return self
 
     def with_desync_detection_mode(self, mode: DesyncDetection) -> "SessionBuilder":
+        """Enable periodic cross-peer checksum comparison (DesyncDetection.on(n))."""
         self._desync = mode
         return self
 
@@ -65,14 +72,17 @@ class SessionBuilder:
         return self
 
     def with_disconnect_timeout(self, seconds: float) -> "SessionBuilder":
+        """Seconds of peer silence before Disconnected."""
         self._disconnect_timeout_s = seconds
         return self
 
     def with_disconnect_notify_delay(self, seconds: float) -> "SessionBuilder":
+        """Seconds of peer silence before NetworkInterrupted."""
         self._disconnect_notify_start_s = seconds
         return self
 
     def add_player(self, kind: PlayerType, handle: int, address: Any = None) -> "SessionBuilder":
+        """Add a LOCAL/REMOTE player (by handle) or a SPECTATOR (by address)."""
         if kind != PlayerType.SPECTATOR and not (0 <= handle < self._num_players):
             raise InvalidRequestError(
                 f"player handle {handle} out of range 0..{self._num_players}"
@@ -83,6 +93,7 @@ class SessionBuilder:
         return self
 
     def start_p2p_session(self, socket) -> P2PSession:
+        """Build a python-core P2P session over the given socket."""
         handles = {p.handle for p in self._players if p.kind != PlayerType.SPECTATOR}
         if handles != set(range(self._num_players)):
             raise InvalidRequestError(

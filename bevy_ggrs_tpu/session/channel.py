@@ -26,6 +26,7 @@ class ChannelNetwork:
         self._clock = 0
 
     def endpoint(self, name: Any) -> "ChannelSocket":
+        """Create/fetch the named endpoint's socket."""
         self._queues.setdefault(name, [])
         return ChannelSocket(self, name)
 

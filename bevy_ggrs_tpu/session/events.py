@@ -26,6 +26,7 @@ class SessionState(enum.Enum):
 
 
 class PlayerType(enum.Enum):
+    """LOCAL / REMOTE / SPECTATOR (PlayerType analog)."""
     LOCAL = "local"
     REMOTE = "remote"
     SPECTATOR = "spectator"
@@ -33,6 +34,7 @@ class PlayerType(enum.Enum):
 
 @dataclass(frozen=True)
 class Player:
+    """One player slot: kind + handle (+ peer address for remote/spectator)."""
     kind: PlayerType
     handle: int
     address: Optional[Any] = None  # remote/spectator peer address
@@ -64,6 +66,7 @@ DesyncDetection.OFF = DesyncDetection(None)
 
 @dataclass(frozen=True)
 class Synchronizing:
+    """Sync handshake progress with a peer (count/total roundtrips)."""
     addr: Any
     total: int
     count: int
@@ -71,27 +74,32 @@ class Synchronizing:
 
 @dataclass(frozen=True)
 class Synchronized:
+    """Peer completed the sync handshake."""
     addr: Any
 
 
 @dataclass(frozen=True)
 class Disconnected:
+    """Peer exceeded the disconnect timeout."""
     addr: Any
 
 
 @dataclass(frozen=True)
 class NetworkInterrupted:
+    """Peer quiet past the notify threshold (may still resume)."""
     addr: Any
     disconnect_timeout_ms: int
 
 
 @dataclass(frozen=True)
 class NetworkResumed:
+    """Interrupted peer spoke again."""
     addr: Any
 
 
 @dataclass(frozen=True)
 class DesyncDetected:
+    """A confirmed frame's checksum differs from a peer's."""
     frame: int
     local_checksum: int
     remote_checksum: int
@@ -102,6 +110,7 @@ class DesyncDetected:
 
 
 class GgrsError(Exception):
+    """Base class of session errors (GgrsError analog)."""
     pass
 
 

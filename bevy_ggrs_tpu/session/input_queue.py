@@ -28,6 +28,7 @@ def predict_repeat_last(queue: "InputQueue", frame: int):
 
 
 class InputQueue:
+    """Per-player input queue: delay, prediction, misprediction tracking (see module docstring)."""
     def __init__(self, input_shape=(), input_dtype=np.uint8, delay: int = 0,
                  predictor=None):
         self.input_shape = tuple(input_shape)
@@ -121,6 +122,7 @@ class InputQueue:
         return self._inputs.get(frame)
 
     def take_first_incorrect(self) -> int:
+        """Pop the earliest mispredicted frame (NULL_FRAME if none)."""
         f = self.first_incorrect
         self.first_incorrect = NULL_FRAME
         return f

@@ -57,6 +57,7 @@ def _build_if_needed() -> None:
 
 
 def load_library() -> C.CDLL:
+    """Load (building if needed) libggrs_core.so and bind its C API."""
     global _lib
     if _lib is not None:
         return _lib
@@ -97,6 +98,7 @@ def load_library() -> C.CDLL:
 
 
 def native_available() -> bool:
+    """True if the native core library can be loaded/built."""
     try:
         load_library()
         return True
@@ -195,16 +197,19 @@ class NativeP2PSession:
         )
 
     def local_player_handles(self) -> List[int]:
+        """Handles owned by this session."""
         buf = (C.c_int32 * self._num_players)()
         n = self._lib.ggrs_p2p_local_handles(self._s, buf, self._num_players)
         return [int(buf[i]) for i in range(n)]
 
     def poll_remote_clients(self) -> None:
+        """Drive the native socket/protocol; drain events and checksums."""
         self._lib.ggrs_p2p_poll(self._s)
         self._flush_checksums()
         self._drain_events()
 
     def add_local_input(self, handle: int, value) -> None:
+        """Stage this tick's input for a local handle."""
         raw = np.asarray(value, self.input_dtype).reshape(self.input_shape)
         rc = self._lib.ggrs_p2p_add_local_input(
             self._s, handle, np.ascontiguousarray(raw).tobytes()
@@ -215,6 +220,7 @@ class NativeP2PSession:
             raise InvalidRequestError(f"add_local_input rc={rc}")
 
     def advance_frame(self) -> List:
+        """Run the native advance/rollback decision; decode the request stream."""
         n_req = C.c_int(0)
         n_in = C.c_int(0)
         rc = self._lib.ggrs_p2p_advance(
@@ -255,10 +261,12 @@ class NativeP2PSession:
         return requests
 
     def events(self):
+        """Drain pending session events."""
         out, self.events_buf = self.events_buf, []
         return out
 
     def network_stats(self, handle: int) -> NetworkStats:
+        """Ping/queue/kbps/frames-behind for a remote handle."""
         ping = C.c_double(0)
         q = C.c_int(0)
         kbps = C.c_double(0)

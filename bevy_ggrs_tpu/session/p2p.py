@@ -36,6 +36,7 @@ from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 
 
 class P2PSession:
+    """Python-core P2P session (see module docstring for semantics)."""
     def __init__(
         self,
         num_players: int,
@@ -142,12 +143,14 @@ class P2PSession:
         return list(self.local_handles)
 
     def current_state(self) -> SessionState:
+        """SYNCHRONIZING until every connected endpoint finished its handshake."""
         eps = list(self.endpoints.values()) + list(self.spectator_endpoints.values())
         if all(ep.state == SessionState.RUNNING or ep.disconnected for ep in eps):
             return SessionState.RUNNING
         return SessionState.SYNCHRONIZING
 
     def frames_ahead(self) -> int:
+        """Smoothed frames-ahead estimate driving run-slow."""
         vals = [
             ep.time_sync.frames_ahead()
             for ep in self.endpoints.values()
@@ -156,10 +159,12 @@ class P2PSession:
         return max(vals) if vals else 0
 
     def events(self):
+        """Drain pending session events."""
         out, self.events_buf = self.events_buf, []
         return out
 
     def network_stats(self, handle: int) -> NetworkStats:
+        """Ping/queue/kbps/frames-behind for a remote handle."""
         addr = self.remote_handle_addr.get(handle)
         if addr is None or addr not in self.endpoints:
             raise InvalidRequestError(f"no remote endpoint for handle {handle}")
@@ -226,6 +231,7 @@ class P2PSession:
     # -- advancing ----------------------------------------------------------
 
     def add_local_input(self, handle: int, value) -> None:
+        """Stage this tick's input for a local handle."""
         if handle not in self.local_handles:
             raise InvalidRequestError(f"handle {handle} is not local")
         if self.current_state() != SessionState.RUNNING:
@@ -235,6 +241,7 @@ class P2PSession:
         )
 
     def advance_frame(self) -> List:
+        """Decide save/rollback/advance; returns the request stream."""
         if self.current_state() != SessionState.RUNNING:
             raise NotSynchronizedError()
         missing = set(self.local_handles) - set(self._staged)

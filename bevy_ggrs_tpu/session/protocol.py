@@ -72,6 +72,7 @@ MAX_INPUTS_PER_PACKET = 64
 
 
 def now_s() -> float:
+    """Monotonic seconds (protocol timer clock)."""
     return time.monotonic()
 
 
@@ -305,6 +306,7 @@ class PeerEndpoint:
             )
 
     def stats(self) -> NetworkStats:
+        """NetworkStats snapshot for this endpoint."""
         elapsed = max(now_s() - self._created, 1e-6)
         return NetworkStats(
             ping_ms=self.ping_s * 1e3,

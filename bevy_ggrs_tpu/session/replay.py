@@ -24,6 +24,7 @@ from .requests import AdvanceRequest
 
 
 class InputRecorder:
+    """Captures the last fully-confirmed inputs per frame via the runner's on_advance hook."""
     def __init__(self, num_players: int, input_shape=(), input_dtype=np.uint8):
         self.num_players = num_players
         self.input_shape = tuple(input_shape)
@@ -45,6 +46,7 @@ class InputRecorder:
     # -- persistence --------------------------------------------------------
 
     def save(self, path: str) -> None:
+        """Write the recording to a compressed .npz file."""
         keys = sorted(self.frames)
         np.savez_compressed(
             path,
@@ -59,6 +61,7 @@ class InputRecorder:
 
     @classmethod
     def load(cls, path: str) -> "InputRecorder":
+        """Load a recording written by save()."""
         z = np.load(path, allow_pickle=False)
         rec = cls(
             int(z["num_players"]),
@@ -93,6 +96,7 @@ class ReplaySession:
         return self.current_frame - 1
 
     def current_state(self):
+        """Always RUNNING (no network)."""
         from .events import SessionState
 
         return SessionState.RUNNING
@@ -102,6 +106,7 @@ class ReplaySession:
         return self.current_frame >= self.end_frame
 
     def advance_frame(self) -> List:
+        """Emit the next recorded frame as a confirmed Advance request."""
         if self.current_frame not in self.rec.frames:
             raise PredictionThresholdError()  # gap or end of recording
         inputs = self.rec.frames[self.current_frame]

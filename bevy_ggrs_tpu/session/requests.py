@@ -30,12 +30,14 @@ class SaveCell:
 
 @dataclass
 class SaveRequest:
+    """SaveGameState: snapshot the current frame (cell takes the checksum)."""
     frame: int
     cell: SaveCell
 
 
 @dataclass
 class LoadRequest:
+    """LoadGameState: restore the ring snapshot for `frame`."""
     frame: int
 
 

@@ -26,6 +26,7 @@ from .requests import AdvanceRequest
 
 
 class SpectatorSession:
+    """Replays host-confirmed inputs; never predicts (see module docstring)."""
     is_spectator = True
 
     def __init__(
@@ -83,10 +84,12 @@ class SpectatorSession:
         )
 
     def frames_behind_host(self) -> int:
+        """How far the host's confirmed stream is ahead of us."""
         last = self.endpoint.last_received_frame
         return 0 if last == NULL_FRAME else max(0, last - self.current_frame)
 
     def events(self):
+        """Drain pending session events."""
         out = list(self.endpoint.events)
         self.endpoint.events.clear()
         out += self.events_buf
@@ -97,6 +100,7 @@ class SpectatorSession:
         return self.endpoint.stats()
 
     def poll_remote_clients(self) -> None:
+        """Drain the socket, drive the host endpoint, ack received inputs."""
         for addr, data in self.socket.receive_all():
             if addr == self.host_addr:
                 self.endpoint.handle(data)
@@ -105,6 +109,7 @@ class SpectatorSession:
             self.endpoint.send_input_ack()
 
     def advance_frame(self) -> List:
+        """Replay the next confirmed frame(s); raises PredictionThreshold while waiting."""
         if self.current_state() != SessionState.RUNNING:
             raise NotSynchronizedError()
         if self.current_frame not in self._inputs:

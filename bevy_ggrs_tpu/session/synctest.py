@@ -24,6 +24,7 @@ from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 
 
 class SyncTestSession:
+    """Continuous-resimulation determinism oracle (see module docstring)."""
     def __init__(
         self,
         num_players: int,
@@ -57,6 +58,7 @@ class SyncTestSession:
         return self._max_prediction
 
     def confirmed_frame(self) -> int:
+        """current - check_distance once the warmup window has passed."""
         if self.check_distance == 0:
             return self.current_frame
         if self._age < self.check_distance:
@@ -64,12 +66,14 @@ class SyncTestSession:
         return frame_add(self.current_frame, -self.check_distance)
 
     def add_local_input(self, handle: int, value) -> None:
+        """Stage this tick's input for a handle."""
         if not (0 <= handle < self._num_players):
             raise InvalidRequestError(f"invalid player handle {handle}")
         arr = np.asarray(value, self.input_dtype).reshape(self.input_shape)
         self._staged[handle] = arr
 
     def advance_frame(self) -> List:
+        """Emit save/advance plus the rollback-and-resimulate request batch."""
         if len(self._staged) != self._num_players:
             missing = set(range(self._num_players)) - set(self._staged)
             raise InvalidRequestError(f"missing local input for players {missing}")

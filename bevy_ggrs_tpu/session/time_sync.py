@@ -15,6 +15,7 @@ WINDOW = 40  # frames of smoothing
 
 
 class TimeSync:
+    """Rolling-window frame-advantage smoothing (drives run-slow)."""
     def __init__(self):
         self.local_adv: Deque[int] = deque(maxlen=WINDOW)
         self.remote_adv: Deque[int] = deque(maxlen=WINDOW)
@@ -26,11 +27,13 @@ class TimeSync:
         self.remote_adv.append(remote_advantage)
 
     def local_advantage(self) -> int:
+        """Smoothed local frames-ahead of the peer."""
         if not self.local_adv:
             return 0
         return round(sum(self.local_adv) / len(self.local_adv))
 
     def frames_ahead(self) -> int:
+        """Half the smoothed advantage difference: frames we should yield."""
         if not self.local_adv or not self.remote_adv:
             return 0
         l = sum(self.local_adv) / len(self.local_adv)

@@ -13,6 +13,7 @@ from typing import Any, List, Protocol, Tuple
 
 
 class NonBlockingSocket(Protocol):
+    """Transport protocol: send_to(data, addr) + receive_all()."""
     def send_to(self, data: bytes, addr: Any) -> None: ...
 
     def receive_all(self) -> List[Tuple[Any, bytes]]: ...
@@ -41,6 +42,7 @@ class UdpNonBlockingSocket:
             pass  # non-blocking: drop on full buffer (UDP semantics)
 
     def receive_all(self) -> List[Tuple[Any, bytes]]:
+        """Drain every pending datagram -> [(addr, bytes)]."""
         out = []
         while True:
             try:

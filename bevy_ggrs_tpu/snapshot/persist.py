@@ -19,6 +19,7 @@ _FORMAT_VERSION = 1
 
 
 def save_world(path: str, reg: Registry, world: WorldState, frame: int = 0) -> None:
+    """Serialize a WorldState (+frame) to a compressed .npz checkpoint."""
     leaves, treedef = jax.tree.flatten(world)
     np.savez_compressed(
         path,

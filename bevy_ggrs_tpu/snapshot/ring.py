@@ -110,5 +110,6 @@ class SnapshotRing(Generic[T]):
         return self._frames[0] if self._frames else None
 
     def clear(self) -> None:
+        """Drop every stored snapshot."""
         self._frames.clear()
         self._snapshots.clear()

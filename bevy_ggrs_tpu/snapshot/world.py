@@ -78,6 +78,7 @@ def active_mask(w: WorldState) -> jnp.ndarray:
 
 @dataclass(frozen=True)
 class ComponentSpec:
+    """Static registration record for one component column."""
     name: str
     shape: Tuple[int, ...]
     dtype: Any
@@ -90,6 +91,7 @@ class ComponentSpec:
 
 @dataclass(frozen=True)
 class ResourceSpec:
+    """Static registration record for one resource."""
     name: str
     init: Any
     checksum: bool
@@ -128,6 +130,7 @@ class Registry:
         strategy: Strategy = CopyStrategy,
         required: bool = False,
     ) -> "Registry":
+        """Register a fixed-shape component column (see RollbackApp surface notes)."""
         if name in self.components:
             raise ValueError(f"component {name!r} already registered")
         # defaults live as NUMPY values: registry-held device arrays captured
@@ -171,6 +174,7 @@ class Registry:
         present: bool = True,
         strategy: Strategy = CopyStrategy,
     ) -> "Registry":
+        """Register a resource pytree (with optional initial absence)."""
         if name in self.resources:
             raise ValueError(f"resource {name!r} already registered")
         init = jax.tree.map(np.asarray, init)  # numpy: see register_component
@@ -182,6 +186,7 @@ class Registry:
     # -- state construction ------------------------------------------------
 
     def init_state(self) -> WorldState:
+        """Allocate the empty fixed-capacity WorldState for this registry."""
         cap = self.capacity
         comps = {
             n: jnp.broadcast_to(s.default, (cap, *s.shape)).astype(s.dtype)
@@ -411,6 +416,7 @@ def despawn_confirmed(reg: Registry, w: WorldState, confirmed) -> WorldState:
 def insert_component(
     reg: Registry, w: WorldState, slot, name: str, value
 ) -> WorldState:
+    """Give `slot` the component `name` with `value` (presence mask set)."""
     spec = reg.components[name]
     return dataclasses.replace(
         w,
@@ -420,6 +426,7 @@ def insert_component(
 
 
 def remove_component(reg: Registry, w: WorldState, slot, name: str) -> WorldState:
+    """Clear `slot`'s presence of component `name` (column value retained)."""
     return dataclasses.replace(
         w, has={**w.has, name: w.has[name].at[slot].set(False)}
     )
@@ -443,10 +450,12 @@ def insert_resource(reg: Registry, w: WorldState, name: str, value) -> WorldStat
 
 
 def remove_resource(reg: Registry, w: WorldState, name: str) -> WorldState:
+    """Mark a registered resource absent (value retained for restore)."""
     return dataclasses.replace(
         w, res_present={**w.res_present, name: jnp.asarray(False)}
     )
 
 
 def active_count(w: WorldState) -> jnp.ndarray:
+    """Number of alive, not-despawn-pending entities."""
     return jnp.sum(active_mask(w)).astype(jnp.int32)

@@ -38,20 +38,25 @@ def frame_lt(a: int, b: int) -> bool:
 
 
 def frame_le(a: int, b: int) -> bool:
+    """a <= b under wrapping order."""
     return frame_diff(a, b) <= 0
 
 
 def frame_gt(a: int, b: int) -> bool:
+    """a > b under wrapping order."""
     return frame_diff(a, b) > 0
 
 
 def frame_ge(a: int, b: int) -> bool:
+    """a >= b under wrapping order."""
     return frame_diff(a, b) >= 0
 
 
 def frame_max(a: int, b: int) -> int:
+    """Newer of a, b under wrapping order."""
     return a if frame_ge(a, b) else b
 
 
 def frame_min(a: int, b: int) -> int:
+    """Older of a, b under wrapping order."""
     return a if frame_le(a, b) else b

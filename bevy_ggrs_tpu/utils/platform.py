@@ -12,6 +12,7 @@ import os
 
 
 def apply_platform_env() -> None:
+    """Apply BGT_PLATFORM / BGT_CPU_DEVICES through jax.config."""
     platform = os.environ.get("BGT_PLATFORM")
     ndev = os.environ.get("BGT_CPU_DEVICES")
     if not platform and not ndev:

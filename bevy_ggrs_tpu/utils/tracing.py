@@ -23,6 +23,7 @@ _ENABLED = True
 
 
 def set_tracing(enabled: bool) -> None:
+    """Globally enable/disable span recording."""
     global _ENABLED
     _ENABLED = enabled
 
@@ -43,6 +44,7 @@ def span(name: str):
 
 
 def trace_log(msg: str, *args) -> None:
+    """Debug-level log line on the framework logger."""
     logger.debug(msg, *args)
 
 
@@ -52,4 +54,5 @@ def get_trace_events():
 
 
 def clear_trace_events() -> None:
+    """Reset the recorded span buffer."""
     _EVENTS.clear()

@@ -7,6 +7,9 @@ echo "== native build + tests =="
 make -C native
 make -C native test
 
+echo "== docs coverage =="
+python scripts/docs_check.py
+
 echo "== tests (CPU, 8 virtual devices) =="
 python -m pytest tests/ -q
 
