@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Pong over P2P (or synctest with --synctest): a complete game on the
+framework, with optional speculative rollback hedging (--speculate).
+
+    python examples/pong_p2p.py --synctest --frames 600
+    python examples/pong_p2p.py --local-port 8081 --players local 127.0.0.1:8082
+    python examples/pong_p2p.py --local-port 8082 --players 127.0.0.1:8081 local
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np
+
+from bevy_ggrs_tpu import (
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SpeculationConfig,
+    UdpNonBlockingSocket,
+    pad_candidates,
+)
+from bevy_ggrs_tpu.models import pong
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--synctest", action="store_true")
+    ap.add_argument("--check-distance", type=int, default=5)
+    ap.add_argument("--local-port", type=int, default=0)
+    ap.add_argument("--players", nargs="*", default=["local", "local"])
+    ap.add_argument("--frames", type=int, default=1200)
+    ap.add_argument("--speculate", action="store_true",
+                    help="hedge predicted remote inputs (branch cache)")
+    args = ap.parse_args()
+
+    app = pong.make_app()
+    b = SessionBuilder.for_app(app).with_input_delay(1)
+
+    def read_inputs(handles):
+        # demo AI: each paddle chases the ball
+        pos = runner.read_components(["pos", "kind"])
+        kind = pos["kind"]
+        balls = (kind == pong.K_BALL) & pos["__active__"]
+        ball_y = float(pos["pos"][balls, 1][0]) if balls.any() else 0.0
+        out = {}
+        for h in handles:
+            my_y = float(pos["pos"][h, 1])
+            if ball_y > my_y + 0.2:
+                out[h] = np.uint8(pong.UP)
+            elif ball_y < my_y - 0.2:
+                out[h] = np.uint8(pong.DOWN)
+            else:
+                out[h] = np.uint8(0)
+        return out
+
+    speculation = (
+        SpeculationConfig(candidates_fn=pad_candidates(2, [1], [0, 1, 2]), depth=4)
+        if args.speculate
+        else None
+    )
+
+    if args.synctest or all(p == "local" for p in args.players):
+        session = b.with_check_distance(args.check_distance).start_synctest_session()
+        runner = GgrsRunner(
+            app, session, read_inputs=read_inputs,
+            on_mismatch=lambda e: (_ for _ in ()).throw(SystemExit(f"MISMATCH: {e}")),
+        )
+        for _ in range(args.frames):
+            runner.tick()
+            if pong.winner(runner.world) >= 0:
+                break
+    else:
+        sock = UdpNonBlockingSocket(args.local_port)
+        for handle, spec in enumerate(args.players):
+            if spec == "local":
+                b.add_player(PlayerType.LOCAL, handle)
+            else:
+                host, port = spec.rsplit(":", 1)
+                b.add_player(PlayerType.REMOTE, handle, (host, int(port)))
+        session = b.start_p2p_session(sock)
+        runner = GgrsRunner(app, session, read_inputs=read_inputs,
+                            speculation=speculation,
+                            on_event=lambda e: print(f"event: {e}"))
+        last = time.perf_counter()
+        while runner.frame < args.frames and pong.winner(runner.world) < 0:
+            now = time.perf_counter()
+            runner.update(now - last)
+            last = now
+            time.sleep(0.001)
+
+    score = np.asarray(runner.world.res["score"])
+    w = pong.winner(runner.world)
+    print(f"frame {runner.frame}: score {score[0]}-{score[1]}"
+          + (f" — player {w} wins!" if w >= 0 else ""))
+    print(f"stats: {runner.stats()}")
+
+
+if __name__ == "__main__":
+    main()

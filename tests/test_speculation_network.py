@@ -1,0 +1,91 @@
+"""Speculation under real network rollbacks: with 3-hop latency and 1-frame
+delay, predictions mispredict whenever inputs flip; a hedging runner must
+(a) hit its branch cache and (b) stay bit-identical to a non-hedging peer."""
+
+import numpy as np
+
+from bevy_ggrs_tpu import (
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    SpeculationConfig,
+    pad_candidates,
+)
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+
+
+def test_speculating_peer_agrees_with_plain_peer():
+    net = ChannelNetwork(latency_hops=3, seed=9)
+    socks = [net.endpoint("a"), net.endpoint("b")]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .with_disconnect_timeout(60.0)
+            .with_disconnect_notify_delay(30.0)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a")
+        )
+        session = b.start_p2p_session(socks[i])
+        # only peer 0 hedges: its remote (player 1) flips between two inputs
+        spec = (
+            SpeculationConfig(
+                candidates_fn=pad_candidates(2, [1], list(range(16))), depth=4
+            )
+            if i == 0
+            else None
+        )
+        tick_counter = [0]
+
+        def read_inputs(handles, i=i, tick_counter=tick_counter):
+            tick_counter[0] += 1
+            on = (tick_counter[0] // 5) % 2 == 0  # flip every 5 frames
+            key = {0: "right", 1: "up"}[i]
+            return {h: box_game.keys_to_input(**{key: on}) for h in handles}
+
+        runners.append(
+            GgrsRunner(app, session, read_inputs=read_inputs, speculation=spec)
+        )
+
+    import time
+
+    for _ in range(400):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    for _ in range(120):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+
+    s0 = runners[0].stats()
+    assert s0["rollbacks"] > 0, "latency should have forced rollbacks"
+    assert s0["speculation_hits"] > 0, f"no cache hits: {s0}"
+    # checksum agreement at a confirmed frame both peers still hold
+    f = None
+    for _ in range(40):
+        conf = min(r.session.confirmed_frame() for r in runners)
+        shared = [
+            fr
+            for fr in set(runners[0].ring.frames()) & set(runners[1].ring.frames())
+            if fr <= conf
+        ]
+        if shared:
+            f = max(shared)
+            break
+        net.deliver()
+        (runners[0] if runners[0].frame <= runners[1].frame else runners[1]).update(DT)
+    assert f is not None
+    assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
+        runners[1].ring.peek(f)[1]
+    )
