@@ -129,6 +129,20 @@ class GgrsRunner:
         out = jax.device_get(arrays)
         return {k: np.asarray(v) for k, v in out.items()}
 
+    def profile(self, logdir: str):
+        """Context manager: capture a jax profiler trace of driver activity
+        (device side of the span log — view with TensorBoard/XProf)."""
+        import contextlib
+
+        import jax
+
+        @contextlib.contextmanager
+        def cm():
+            with jax.profiler.trace(logdir):
+                yield self
+
+        return cm()
+
     def stats(self) -> dict:
         """Driver health counters (rollback frequency/depth, dispatches,
         stalls, speculation hit rate)."""
