@@ -40,6 +40,10 @@ class SpeculationConfig:
     candidates_fn: Callable[[np.ndarray], np.ndarray]
     depth: int = 1
     max_cached_frames: int = 4  # keep branches for the newest N start frames
+    # Memory note: the cache retains M x depth x max_cached_frames world
+    # snapshots on device (they share nothing with the ring).  For a 10k-
+    # entity world that is a few hundred KB per snapshot; for very large
+    # worlds lower depth/max_cached_frames or hedge fewer candidates.
 
 
 class SpeculationCache:

@@ -229,3 +229,34 @@ def test_p2p_spectator_trio():
     assert float(spec_runner.world.comps["pos"][0, 0]) > 1.9
     for s in socks:
         s.close()
+
+
+def test_p2p_session_restart():
+    # dropping the session resets driver state; a fresh session on fresh
+    # sockets restarts cleanly from frame 0 (schedule_systems.rs:70-79)
+    runners, socks = make_pair()
+    for _ in range(200):
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    interleave(runners, 30)
+    assert runners[0].frame >= 25
+    for r in runners:
+        r.set_session(None)
+        r.update(1.0)  # no session: accumulator clears, nothing advances
+        assert r.frame == 0
+    for s in socks:
+        s.close()
+    runners2, socks2 = make_pair()
+    for _ in range(200):
+        for r in runners2:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners2):
+            break
+        time.sleep(0.001)
+    interleave(runners2, 20)
+    assert all(r.frame >= 15 for r in runners2)
+    for s in socks2:
+        s.close()
