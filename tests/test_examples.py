@@ -67,3 +67,10 @@ def test_box_game_p2p_pair_example():
             if p.poll() is None:
                 p.kill()
     assert all("done at frame" in o for o in outs), outs[0][-500:]
+
+
+def test_pong_example_synctest():
+    r = run_example(["examples/pong_p2p.py", "--synctest", "--frames", "60",
+                     "--check-distance", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "score" in r.stdout
