@@ -146,6 +146,20 @@ class SessionBuilder:
             max_prediction=self._max_prediction,
         )
 
+    def start_spectator_session_native(self, host_addr: Any, local_port: int = 0):
+        """Spectator session backed by the native C++ core."""
+        from .native import NativeSpectatorSession
+
+        return NativeSpectatorSession(
+            num_players=self._num_players,
+            host_addr=host_addr,
+            local_port=local_port,
+            input_shape=self.input_shape,
+            input_dtype=self.input_dtype,
+            disconnect_timeout_s=self._disconnect_timeout_s,
+            disconnect_notify_start_s=self._disconnect_notify_start_s,
+        )
+
     def start_spectator_session(self, host_addr: Any, socket) -> SpectatorSession:
         return SpectatorSession(
             num_players=self._num_players,

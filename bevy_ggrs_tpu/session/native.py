@@ -93,8 +93,33 @@ def load_library() -> C.CDLL:
     lib.ggrs_p2p_stats.argtypes = [P, C.c_int, C.POINTER(C.c_double),
                                    C.POINTER(C.c_int), C.POINTER(C.c_double),
                                    C.POINTER(C.c_int), C.POINTER(C.c_int)]
+    _bind_spectator(lib)
     _lib = lib
     return lib
+
+
+def _bind_spectator(lib: C.CDLL) -> None:
+    P = C.c_void_p
+    lib.ggrs_spectator_create.restype = P
+    lib.ggrs_spectator_create.argtypes = [C.c_int, C.c_int, C.c_uint16,
+                                          C.c_char_p, C.c_uint16,
+                                          C.c_double, C.c_double, C.c_int]
+    lib.ggrs_spectator_destroy.argtypes = [P]
+    lib.ggrs_spectator_local_port.restype = C.c_uint16
+    lib.ggrs_spectator_local_port.argtypes = [P]
+    lib.ggrs_spectator_poll.argtypes = [P]
+    lib.ggrs_spectator_state.argtypes = [P]
+    lib.ggrs_spectator_current_frame.restype = C.c_int32
+    lib.ggrs_spectator_current_frame.argtypes = [P]
+    lib.ggrs_spectator_frames_behind.restype = C.c_int32
+    lib.ggrs_spectator_frames_behind.argtypes = [P]
+    lib.ggrs_spectator_advance.argtypes = [P, C.POINTER(C.c_int32), C.c_int,
+                                           C.POINTER(C.c_uint8), C.c_int,
+                                           C.POINTER(C.c_int), C.POINTER(C.c_int)]
+    lib.ggrs_spectator_next_event.argtypes = [P, C.POINTER(C.c_int32),
+                                              C.POINTER(C.c_int32),
+                                              C.POINTER(C.c_uint64),
+                                              C.c_char_p, C.c_int]
 
 
 def native_available() -> bool:
@@ -331,3 +356,138 @@ class NativeP2PSession:
 
     def _lookup_local_checksum(self, frame: int):
         return None  # native core keeps it; exposed only for display parity
+
+
+class NativeSpectatorSession:
+    """Spectator session backed by the C++ core: follows a host's confirmed
+    input stream, never predicts (GGRS session surface)."""
+
+    is_spectator = True
+
+    def __init__(
+        self,
+        num_players: int,
+        host_addr,
+        local_port: int = 0,
+        input_shape=(),
+        input_dtype=np.uint8,
+        disconnect_timeout_s: float = 2.0,
+        disconnect_notify_start_s: float = 0.5,
+        catchup_speed: int = 1,
+    ):
+        self._lib = load_library()
+        self._num_players = num_players
+        self.input_shape = tuple(input_shape)
+        self.input_dtype = np.dtype(input_dtype)
+        self.input_size = int(np.prod(self.input_shape, dtype=int) or 1) * self.input_dtype.itemsize
+        ip, port = host_addr
+        self._s = self._lib.ggrs_spectator_create(
+            num_players, self.input_size, local_port, ip.encode(), int(port),
+            disconnect_timeout_s, disconnect_notify_start_s, catchup_speed,
+        )
+        if not self._s:
+            raise InvalidRequestError(f"could not bind UDP port {local_port}")
+        self._req_cap = 1024
+        self._req_buf = (C.c_int32 * self._req_cap)()
+        self._input_cap = 1 << 18
+        self._input_buf = (C.c_uint8 * self._input_cap)()
+        self.events_buf: List = []
+
+    def __del__(self):
+        try:
+            if getattr(self, "_s", None):
+                self._lib.ggrs_spectator_destroy(self._s)
+                self._s = None
+        except Exception:
+            pass
+
+    def local_port(self) -> int:
+        """Bound UDP port (useful with port 0 auto-assignment)."""
+        return int(self._lib.ggrs_spectator_local_port(self._s))
+
+    def num_players(self) -> int:
+        return self._num_players
+
+    def max_prediction(self) -> int:
+        return 0  # spectators never predict
+
+    def confirmed_frame(self) -> int:
+        return self.current_frame() - 1
+
+    def current_frame(self) -> int:
+        """Next frame to replay."""
+        return int(self._lib.ggrs_spectator_current_frame(self._s))
+
+    def frames_behind_host(self) -> int:
+        """How far the host's confirmed stream is ahead of us."""
+        return int(self._lib.ggrs_spectator_frames_behind(self._s))
+
+    def current_state(self) -> SessionState:
+        return (
+            SessionState.RUNNING
+            if self._lib.ggrs_spectator_state(self._s) == 1
+            else SessionState.SYNCHRONIZING
+        )
+
+    def poll_remote_clients(self) -> None:
+        """Drive the native socket/protocol; drain events."""
+        self._lib.ggrs_spectator_poll(self._s)
+        self._drain_events()
+
+    def advance_frame(self) -> List:
+        """Replay the next confirmed frame(s) from the host stream."""
+        n_req = C.c_int(0)
+        n_in = C.c_int(0)
+        rc = self._lib.ggrs_spectator_advance(
+            self._s, self._req_buf, self._req_cap,
+            self._input_buf, self._input_cap, C.byref(n_req), C.byref(n_in),
+        )
+        if rc == _ERR_PREDICTION:
+            raise PredictionThresholdError()
+        if rc == _ERR_NOT_SYNC:
+            raise NotSynchronizedError()
+        if rc != _OK:
+            raise InvalidRequestError(f"spectator advance rc={rc}")
+        words = np.ctypeslib.as_array(self._req_buf, (n_req.value,))
+        ibytes = bytes(bytearray(self._input_buf[: n_in.value]))
+        P = self._num_players
+        row = P * self.input_size
+        requests: List = []
+        i = 0
+        off = 0
+        while i < n_req.value:
+            status = np.array(words[i + 2 : i + 2 + P], np.int8)
+            chunk = ibytes[off : off + row]
+            off += row
+            inputs = np.frombuffer(chunk, self.input_dtype).reshape(
+                (P, *self.input_shape)
+            )
+            requests.append(AdvanceRequest(inputs.copy(), status))
+            i += 2 + P
+        return requests
+
+    def events(self):
+        """Drain pending session events."""
+        out, self.events_buf = self.events_buf, []
+        return out
+
+    def _drain_events(self) -> None:
+        kind = C.c_int32(0)
+        a = C.c_int32(0)
+        b = C.c_uint64(0)
+        addr = C.create_string_buffer(64)
+        while self._lib.ggrs_spectator_next_event(
+            self._s, C.byref(kind), C.byref(a), C.byref(b), addr, 64
+        ):
+            s = addr.value.decode()
+            k = kind.value
+            if k == _EV_SYNCING:
+                self.events_buf.append(Synchronizing(s, int(b.value), a.value))
+            elif k == _EV_SYNCED:
+                self.events_buf.append(Synchronized(s))
+            elif k == _EV_DISC:
+                self.events_buf.append(Disconnected(s))
+            elif k == _EV_INT:
+                self.events_buf.append(NetworkInterrupted(s, a.value))
+            elif k == _EV_RES:
+                self.events_buf.append(NetworkResumed(s))

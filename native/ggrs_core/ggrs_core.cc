@@ -854,6 +854,117 @@ void ggrs_p2p_push_checksum(GgrsP2P *s, int32_t frame, uint64_t checksum) {
       ep->send_checksum(frame, checksum);
 }
 
+/* ---- spectator client session ------------------------------------------ */
+
+struct GgrsSpectator {
+  int num_players = 2, input_size = 1, catchup_speed = 1;
+  UdpSocket sock;
+  Addr host;
+  std::unique_ptr<Endpoint> ep;
+  Frame current_frame = 0;
+  std::map<Frame, std::vector<uint8_t>, bool (*)(Frame, Frame)> inputs{frame_lt};
+  std::deque<Event> events;
+  std::mt19937 rng{std::random_device{}()};
+};
+
+extern "C" {
+
+GgrsSpectator *ggrs_spectator_create(int num_players, int input_size,
+                                     uint16_t local_port, const char *host_ip,
+                                     uint16_t host_port,
+                                     double disconnect_timeout_s,
+                                     double disconnect_notify_s,
+                                     int catchup_speed) {
+  auto *s = new GgrsSpectator();
+  s->num_players = num_players;
+  s->input_size = input_size;
+  s->catchup_speed = catchup_speed;
+  if (!s->sock.open(local_port)) { delete s; return nullptr; }
+  s->host.ip = inet_addr(host_ip ? host_ip : "127.0.0.1");
+  s->host.port = host_port;
+  auto ep = std::make_unique<Endpoint>();
+  ep->addr = s->host;
+  ep->sock = &s->sock;
+  ep->input_size = input_size * num_players; /* full-row stream */
+  ep->sync_nonce = s->rng();
+  ep->disconnect_timeout_s = disconnect_timeout_s;
+  ep->disconnect_notify_s = disconnect_notify_s;
+  ep->init(now_s());
+  s->ep = std::move(ep);
+  return s;
+}
+
+void ggrs_spectator_destroy(GgrsSpectator *s) { delete s; }
+uint16_t ggrs_spectator_local_port(GgrsSpectator *s) { return s->sock.local_port(); }
+int ggrs_spectator_state(GgrsSpectator *s) { return s->ep->state; }
+int32_t ggrs_spectator_current_frame(GgrsSpectator *s) { return s->current_frame; }
+
+int32_t ggrs_spectator_frames_behind(GgrsSpectator *s) {
+  if (s->ep->last_received_frame == NULL_FRAME) return 0;
+  Frame d = frame_diff(s->ep->last_received_frame, s->current_frame);
+  return d > 0 ? d : 0;
+}
+
+void ggrs_spectator_poll(GgrsSpectator *s) {
+  uint8_t buf[65536];
+  Addr from;
+  int n;
+  while ((n = s->sock.recv_from(&from, buf, sizeof buf)) >= 0)
+    if (from == s->host) s->ep->handle(buf, (size_t)n);
+  s->ep->poll();
+  for (auto &e : s->ep->events) s->events.push_back(e);
+  s->ep->events.clear();
+  for (auto &[f, raw] : s->ep->inbox) s->inputs[f] = raw;
+  s->ep->inbox.clear();
+  s->ep->checksum_inbox.clear();
+  if (s->ep->state == GGRS_RUNNING) s->ep->send_input_ack();
+}
+
+int ggrs_spectator_advance(GgrsSpectator *s, int32_t *req_buf, int req_cap,
+                           uint8_t *input_buf, int input_cap,
+                           int *n_req_words, int *n_input_bytes) {
+  *n_req_words = 0;
+  *n_input_bytes = 0;
+  if (s->ep->state != GGRS_RUNNING) return GGRS_ERR_NOT_SYNCHRONIZED;
+  if (!s->inputs.count(s->current_frame))
+    return GGRS_ERR_PREDICTION_THRESHOLD;
+  int n = 1;
+  if (ggrs_spectator_frames_behind(s) > 2) n += s->catchup_speed > 0 ? s->catchup_speed : 0;
+  int rw = 0, ib = 0;
+  int row = s->num_players * s->input_size;
+  for (int i = 0; i < n; i++) {
+    auto it = s->inputs.find(s->current_frame);
+    if (it == s->inputs.end()) break;
+    if (rw + 2 + s->num_players > req_cap || ib + row > input_cap)
+      return GGRS_ERR_BUFFER_TOO_SMALL;
+    req_buf[rw++] = GGRS_REQ_ADVANCE;
+    req_buf[rw++] = s->current_frame;
+    for (int h = 0; h < s->num_players; h++) req_buf[rw++] = GGRS_INPUT_CONFIRMED;
+    memcpy(input_buf + ib, it->second.data(), row);
+    ib += row;
+    s->inputs.erase(it);
+    s->current_frame = s->current_frame + 1;
+  }
+  *n_req_words = rw;
+  *n_input_bytes = ib;
+  return GGRS_OK;
+}
+
+int ggrs_spectator_next_event(GgrsSpectator *s, int32_t *kind, int32_t *a,
+                              uint64_t *b, char *addrbuf, int addrcap) {
+  if (s->events.empty()) return 0;
+  Event e = s->events.front();
+  s->events.pop_front();
+  *kind = e.kind;
+  *a = e.a;
+  *b = e.b;
+  std::string str = e.addr.str();
+  snprintf(addrbuf, addrcap, "%s", str.c_str());
+  return 1;
+}
+
+} /* extern "C" */
+
 int ggrs_p2p_stats(GgrsP2P *s, int handle, double *ping_ms, int *send_queue,
                    double *kbps_sent, int *local_frames_behind,
                    int *remote_frames_behind) {

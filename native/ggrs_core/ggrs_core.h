@@ -88,6 +88,30 @@ int ggrs_p2p_next_event(GgrsP2P *s, int32_t *kind, int32_t *a, uint64_t *b,
 /* desync detection: the TPU side pushes confirmed-frame checksums here */
 void ggrs_p2p_push_checksum(GgrsP2P *s, int32_t frame, uint64_t checksum);
 
+/* ---- spectator client session ------------------------------------------
+ * Follows a host's confirmed all-player input stream; never predicts.
+ * ggrs_spectator_advance fills one-or-more ADVANCE records (same encoding
+ * as ggrs_p2p_advance, catch-up emits several) or returns
+ * GGRS_ERR_PREDICTION_THRESHOLD while waiting for the next frame. */
+typedef struct GgrsSpectator GgrsSpectator;
+GgrsSpectator *ggrs_spectator_create(int num_players, int input_size,
+                                     uint16_t local_port, const char *host_ip,
+                                     uint16_t host_port,
+                                     double disconnect_timeout_s,
+                                     double disconnect_notify_s,
+                                     int catchup_speed);
+void ggrs_spectator_destroy(GgrsSpectator *s);
+uint16_t ggrs_spectator_local_port(GgrsSpectator *s);
+void ggrs_spectator_poll(GgrsSpectator *s);
+int ggrs_spectator_state(GgrsSpectator *s);
+int32_t ggrs_spectator_current_frame(GgrsSpectator *s);
+int32_t ggrs_spectator_frames_behind(GgrsSpectator *s);
+int ggrs_spectator_advance(GgrsSpectator *s, int32_t *req_buf, int req_cap,
+                           uint8_t *input_buf, int input_cap,
+                           int *n_req_words, int *n_input_bytes);
+int ggrs_spectator_next_event(GgrsSpectator *s, int32_t *kind, int32_t *a,
+                              uint64_t *b, char *addrbuf, int addrcap);
+
 /* network stats for a remote handle */
 int ggrs_p2p_stats(GgrsP2P *s, int handle, double *ping_ms, int *send_queue,
                    double *kbps_sent, int *local_frames_behind,

@@ -4,9 +4,15 @@
 
 #include "ggrs_core.h"
 
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <cassert>
 #include <cstdio>
 #include <cstring>
+#include <random>
 #include <vector>
 
 static int failures = 0;
@@ -99,7 +105,51 @@ static void test_invalid_usage() {
   ggrs_p2p_destroy(a);
 }
 
+static void test_packet_fuzz() {
+  /* random bytes into the packet handler must never crash or corrupt.
+   * The fuzzer socket IS the registered peer, so its garbage reaches the
+   * parser (packets from unknown sources are dropped earlier). */
+  int fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+  sockaddr_in me{};
+  me.sin_family = AF_INET;
+  me.sin_addr.s_addr = inet_addr("127.0.0.1");
+  me.sin_port = 0;
+  CHECK(bind(fd, (sockaddr *)&me, sizeof me) == 0);
+  socklen_t mlen = sizeof me;
+  getsockname(fd, (sockaddr *)&me, &mlen);
+  uint16_t fuzz_port = ntohs(me.sin_port);
+
+  GgrsP2P *a = ggrs_p2p_create(2, 2, 0, 8, 0, 10, 60.0, 30.0);
+  uint16_t pa = ggrs_p2p_local_port(a);
+  ggrs_p2p_add_player(a, GGRS_LOCAL, 0, nullptr, 0);
+  ggrs_p2p_add_player(a, GGRS_REMOTE, 1, "127.0.0.1", fuzz_port);
+  ggrs_p2p_start(a);
+
+  sockaddr_in dst{};
+  dst.sin_family = AF_INET;
+  dst.sin_addr.s_addr = inet_addr("127.0.0.1");
+  dst.sin_port = htons(pa);
+  std::mt19937 rng(7);
+  uint8_t buf[512];
+  for (int i = 0; i < 5000; i++) {
+    size_t len = rng() % sizeof buf;
+    for (size_t j = 0; j < len; j++) buf[j] = (uint8_t)rng();
+    if (rng() % 2) { buf[0] = 0xA7; buf[1] = 0x47; }  /* valid magic, evil body */
+    if (len > 2 && rng() % 4 == 0) buf[2] = (uint8_t)(1 + rng() % 8);
+    (void)sendto(fd, buf, len, 0, (sockaddr *)&dst, sizeof dst);
+    if (i % 50 == 0) ggrs_p2p_poll(a);
+  }
+  ggrs_p2p_poll(a);
+  ::close(fd);
+  /* session alive and well-behaved after the storm */
+  CHECK(ggrs_p2p_state(a) == GGRS_SYNCHRONIZING || ggrs_p2p_state(a) == GGRS_RUNNING);
+  int32_t handles[2];
+  CHECK(ggrs_p2p_local_handles(a, handles, 2) == 1);
+  ggrs_p2p_destroy(a);
+}
+
 int main() {
+  test_packet_fuzz();
   test_invalid_usage();
   test_buffer_too_small();
   test_session_lifecycle();

@@ -228,3 +228,45 @@ def test_native_host_python_spectator():
     assert r_spec.frame > 20
     assert float(r_spec.world.comps["pos"][0, 0]) > 1.9  # replayed movement
     spec_sock.close()
+
+
+def test_native_spectator_follows_python_host():
+    from bevy_ggrs_tpu import SessionBuilder as SB
+
+    p_host, p_peer, p_spec = free_ports(3)
+    # python host streaming to a NATIVE spectator; python remote peer
+    app0 = box_game.make_app(num_players=2)
+    sock0 = UdpNonBlockingSocket(p_host, host="0.0.0.0")
+    b0 = (
+        SB.for_app(app0)
+        .with_input_delay(1)
+        .add_player(PlayerType.LOCAL, 0)
+        .add_player(PlayerType.REMOTE, 1, ("127.0.0.1", p_peer))
+        .add_player(PlayerType.SPECTATOR, 2, ("127.0.0.1", p_spec))
+    )
+    r0 = GgrsRunner(
+        app0, b0.start_p2p_session(sock0),
+        read_inputs=lambda hs: {h: box_game.keys_to_input(right=True) for h in hs},
+    )
+    app1 = box_game.make_app(num_players=2)
+    sock1 = UdpNonBlockingSocket(p_peer, host="0.0.0.0")
+    b1 = (
+        SB.for_app(app1)
+        .with_input_delay(1)
+        .add_player(PlayerType.REMOTE, 0, ("127.0.0.1", p_host))
+        .add_player(PlayerType.LOCAL, 1)
+    )
+    r1 = GgrsRunner(app1, b1.start_p2p_session(sock1))
+
+    spec_app = box_game.make_app(num_players=2)
+    spec_session = SB.for_app(spec_app).start_spectator_session_native(
+        ("127.0.0.1", p_host), local_port=p_spec
+    )
+    r_spec = GgrsRunner(spec_app, spec_session)
+    everyone = [r0, r1, r_spec]
+    assert sync_all(everyone)
+    interleave(everyone, 100)
+    assert r_spec.frame > 20
+    assert float(r_spec.world.comps["pos"][0, 0]) > 1.9
+    sock0.close()
+    sock1.close()
