@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement suite — run FIRST THING in a round while the TPU
+# tunnel is healthy (see docs/tpu_notes.md §4 for why it may not be):
+#   bash scripts/tpu_measure.sh | tee TPU_MEASUREMENTS.txt
+# Runs on the default (accelerator) backend; each step prints JSON/lines.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== backend probe =="
+timeout 90 python -c "import jax; d=jax.devices(); print(d)" || {
+  echo "TPU backend unusable — aborting (do NOT kill -9 while claimed)"; exit 1; }
+
+echo "== headline bench (bench.py) =="
+python bench.py
+
+echo "== criterion equivalents =="
+python benches/criterion_equiv.py --iters 100
+
+echo "== cross-backend checksum parity =="
+python scripts/parity_check.py
+
+echo "== examples on device (quick) =="
+python examples/box_game_synctest.py --frames 120 --check-distance 3
+python examples/particles_stress.py --rate 100 --synctest --frames 120 --check-distance 3
+
+echo "ALL TPU MEASUREMENTS DONE"
