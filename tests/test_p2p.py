@@ -82,6 +82,9 @@ def test_p2p_smoke_remote_input_moves_entity():
     assert float(runners[0].world.comps["pos"][1, 1]) != y0[0]
     assert float(runners[1].world.comps["pos"][1, 1]) != y0[1]
     assert runners[0].frame >= 50 and runners[1].frame >= 50
+    # network stats populated after sustained traffic
+    stats = runners[0].session.network_stats(1)
+    assert stats.kbps_sent > 0
     for s in socks:
         s.close()
 
