@@ -74,6 +74,9 @@ class P2PSession:
                 self.remote_handle_addr[p.handle] = p.address
             else:
                 self.spectator_addrs.append(p.address)
+        # wire rows pack local inputs in ascending-handle order and the
+        # receiver unpacks the same way — sort so add_player order is free
+        self.local_handles.sort()
 
         self.queues: Dict[int, InputQueue] = {
             h: InputQueue(self.input_shape, self.input_dtype,

@@ -9,6 +9,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
@@ -541,6 +542,9 @@ int ggrs_p2p_add_player(GgrsP2P *s, int kind, int handle, const char *ip,
 int ggrs_p2p_start(GgrsP2P *s) {
   size_t have = s->local_handles.size() + s->remote_handle_addr.size();
   if ((int)have != s->num_players) return GGRS_ERR_INVALID_REQUEST;
+  /* wire rows pack local inputs in ascending-handle order (receivers unpack
+   * via the sorted handles_of_addr) — sort so add_player order is free */
+  std::sort(s->local_handles.begin(), s->local_handles.end());
   double t = now_s();
   for (auto &[addr, handles] : s->handles_of_addr) {
     auto ep = std::make_unique<Endpoint>();

@@ -37,7 +37,9 @@ def test_two_local_players_per_peer(native):
     runners = []
     for i in range(2):
         app = box_game.make_app(num_players=4)
-        mine = [0, 1] if i == 0 else [2, 3]
+        # REGRESSION: declare local handles in descending order — the wire
+        # row order must not depend on add_player order
+        mine = [1, 0] if i == 0 else [3, 2]
         theirs = [2, 3] if i == 0 else [0, 1]
         b = SessionBuilder.for_app(app).with_input_delay(1)
         for h in mine:
@@ -55,7 +57,7 @@ def test_two_local_players_per_peer(native):
             GgrsRunner(app, session,
                        read_inputs=lambda hs: {h: keys[h] for h in hs})
         )
-        assert sorted(session.local_player_handles()) == mine
+        assert sorted(session.local_player_handles()) == sorted(mine)
 
     import time
 
