@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..utils.frames import frame_add
 from .events import InputStatus, PredictionThresholdError
 from .requests import AdvanceRequest
 
@@ -93,7 +94,7 @@ class ReplaySession:
         return 0
 
     def confirmed_frame(self) -> int:
-        return self.current_frame - 1
+        return frame_add(self.current_frame, -1)
 
     def current_state(self):
         """Always RUNNING (no network)."""
@@ -110,6 +111,6 @@ class ReplaySession:
         if self.current_frame not in self.rec.frames:
             raise PredictionThresholdError()  # gap or end of recording
         inputs = self.rec.frames[self.current_frame]
-        self.current_frame += 1
+        self.current_frame = frame_add(self.current_frame, 1)
         status = np.full((self.rec.num_players,), InputStatus.CONFIRMED, np.int8)
         return [AdvanceRequest(inputs, status)]

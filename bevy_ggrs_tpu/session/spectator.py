@@ -13,7 +13,7 @@ from typing import Any, Dict, List
 
 import numpy as np
 
-from ..utils.frames import NULL_FRAME
+from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import (
     InputStatus,
     NetworkStats,
@@ -74,7 +74,7 @@ class SpectatorSession:
         return 0  # spectators never predict (schedule_systems.rs:200)
 
     def confirmed_frame(self) -> int:
-        return self.current_frame - 1
+        return frame_add(self.current_frame, -1)
 
     def current_state(self) -> SessionState:
         return (
@@ -86,7 +86,9 @@ class SpectatorSession:
     def frames_behind_host(self) -> int:
         """How far the host's confirmed stream is ahead of us."""
         last = self.endpoint.last_received_frame
-        return 0 if last == NULL_FRAME else max(0, last - self.current_frame)
+        if last == NULL_FRAME:
+            return 0
+        return max(0, frame_diff(last, self.current_frame))
 
     def events(self):
         """Drain pending session events."""
@@ -125,6 +127,6 @@ class SpectatorSession:
             if self.current_frame not in self._inputs:
                 break
             inputs = self._inputs.pop(self.current_frame)
-            self.current_frame += 1
+            self.current_frame = frame_add(self.current_frame, 1)
             requests.append(AdvanceRequest(np.asarray(inputs), status))
         return requests
