@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Multi-chip crowd demo: a large flocking sim sharded over a device mesh,
+with speculative branches on the "spec" axis — the scale-out path
+(docs/architecture.md "Multi-chip").
+
+    BGT_PLATFORM=cpu BGT_CPU_DEVICES=8 python examples/crowd_multichip.py
+    # on a TPU pod slice: just run it (uses all visible devices)
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import jax
+import numpy as np
+
+from bevy_ggrs_tpu.models import crowd
+from bevy_ggrs_tpu.parallel import make_mesh, make_sharded_resim_fn, make_sharded_speculate_fn
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-team", type=int, default=4096)
+    ap.add_argument("--teams", type=int, default=2)
+    ap.add_argument("--frames", type=int, default=64)
+    ap.add_argument("--branches", type=int, default=4)
+    ap.add_argument("--spec-axis", type=int, default=2)
+    args = ap.parse_args()
+
+    n_dev = len(jax.devices())
+    n_spec = args.spec_axis if n_dev % args.spec_axis == 0 else 1
+    mesh = make_mesh(n_data=n_dev // n_spec, n_spec=n_spec)
+    print(f"devices: {n_dev} ({jax.devices()[0].platform}), mesh {dict(mesh.shape)}")
+
+    app = crowd.make_app(n_per_team=args.per_team, num_teams=args.teams)
+    world = app.init_state()
+    k = 8
+    inputs = np.zeros((k, args.teams), np.uint8)
+    status = np.zeros((k, args.teams), np.int8)
+
+    resim = make_sharded_resim_fn(app, mesh)
+    out = resim(world, inputs, status, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    steps = max(args.frames // k, 1)
+    w = world
+    for i in range(steps):
+        w, stacked, checks = resim(w, inputs, status, i * k)
+    jax.block_until_ready(w)
+    dt = time.perf_counter() - t0
+    n = args.per_team * args.teams
+    print(f"sharded resim: {steps * k} frames x {n} boids in {dt:.2f}s "
+          f"({steps * k / dt:.0f} fps), checksum {checksum_to_int(checks[-1]):#x}")
+
+    spec = make_sharded_speculate_fn(app, mesh)
+    bi = np.zeros((args.branches, k, args.teams), np.uint8)
+    for b in range(args.branches):
+        bi[b, :, :] = b  # distinct steering per branch
+    bs = np.zeros((args.branches, k, args.teams), np.int8)
+    out = spec(world, bi, bs, 0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    finals, stacked, checks = spec(world, bi, bs, 0)
+    jax.block_until_ready(checks)
+    dt = time.perf_counter() - t0
+    print(f"speculative fan-out: {args.branches} branches x {k} frames in "
+          f"{dt * 1e3:.0f} ms ({args.branches * k / dt:.0f} resim-fps)")
+
+
+if __name__ == "__main__":
+    main()

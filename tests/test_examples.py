@@ -74,3 +74,14 @@ def test_pong_example_synctest():
                      "--check-distance", "2"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "score" in r.stdout
+
+
+def test_crowd_multichip_example():
+    env = dict(os.environ, BGT_PLATFORM="cpu", BGT_CPU_DEVICES="8")
+    r = subprocess.run(
+        [sys.executable, "examples/crowd_multichip.py",
+         "--per-team", "256", "--frames", "16"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "speculative fan-out" in r.stdout
