@@ -1,0 +1,55 @@
+"""Static guard: no module-level jnp/jax.numpy constant assignments in the
+package.  Pre-existing device arrays captured by jitted functions become
+per-call parameter buffers — a measured ~4 ms/dispatch slow path through the
+TPU tunnel (docs/tpu_notes.md §1).  Constants must be numpy scalars/arrays
+or created during tracing."""
+
+import ast
+import os
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "bevy_ggrs_tpu")
+
+
+def _is_jnp_call(node) -> bool:
+    """True for jnp.<anything>(...) / jax.numpy.<...>(...) expressions."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        parts = []
+        while isinstance(f, ast.Attribute):
+            parts.append(f.attr)
+            f = f.value
+        if isinstance(f, ast.Name):
+            parts.append(f.id)
+        parts.reverse()
+        if parts and parts[0] in ("jnp",):
+            return True
+        if len(parts) >= 2 and parts[0] == "jax" and parts[1] == "numpy":
+            return True
+    return False
+
+
+def test_no_module_level_jnp_constants():
+    offenders = []
+    for root, _, files in os.walk(PKG):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            tree = ast.parse(open(path).read())
+            for node in tree.body:  # module level only
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = [node.value]
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.value]
+                for value in targets:
+                    for sub in ast.walk(value):
+                        if _is_jnp_call(sub):
+                            offenders.append(
+                                f"{os.path.relpath(path, PKG)}:{node.lineno}"
+                            )
+    assert not offenders, (
+        "module-level jnp constants (TPU dispatch poison, tpu_notes.md §1): "
+        + ", ".join(offenders)
+    )
