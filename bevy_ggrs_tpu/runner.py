@@ -116,6 +116,13 @@ class GgrsRunner:
             self._step_session()
             fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
 
+    @property
+    def checksum(self) -> int:
+        """Current world checksum as the 64-bit cross-peer value (the
+        user-readable ``Checksum`` resource analog, checksum.rs:48-56).
+        Forces a device sync."""
+        return checksum_to_int(self._world_checksum)
+
     def read_components(self, names=None) -> dict:
         """Fetch component columns (and the active mask) to host numpy in one
         transfer — the render-readback path.  ``names=None`` fetches all."""
