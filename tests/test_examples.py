@@ -85,3 +85,51 @@ def test_crowd_multichip_example():
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "speculative fan-out" in r.stdout
+
+
+def test_spectator_cli_follows_host_pair():
+    import socket as so
+
+    socks = [so.socket(so.AF_INET, so.SOCK_DGRAM) for _ in range(3)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    env = dict(os.environ, BGT_PLATFORM="cpu")
+    host = subprocess.Popen(
+        [sys.executable, "examples/box_game_p2p.py",
+         "--local-port", str(ports[0]),
+         "--players", "local", f"127.0.0.1:{ports[1]}",
+         "--spectators", f"127.0.0.1:{ports[2]}",
+         "--frames", "150"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    peer = subprocess.Popen(
+        [sys.executable, "examples/box_game_p2p.py",
+         "--local-port", str(ports[1]),
+         "--players", f"127.0.0.1:{ports[0]}", "local",
+         "--frames", "150"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    spec = subprocess.Popen(
+        [sys.executable, "examples/box_game_spectator.py",
+         "--local-port", str(ports[2]),
+         "--host", f"127.0.0.1:{ports[0]}",
+         "--frames", "100"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        s_out, _ = spec.communicate(timeout=240)
+        h_out, _ = host.communicate(timeout=60)
+        p_out, _ = peer.communicate(timeout=60)
+    finally:
+        for p in (host, peer, spec):
+            if p.poll() is None:
+                p.kill()
+    assert spec.returncode == 0, s_out[-2000:]
+    assert "frame" in s_out
+    assert host.returncode == 0, h_out[-2000:]
