@@ -17,7 +17,15 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
-from ..utils.frames import NULL_FRAME, frame_add, frame_gt, frame_le, frame_lt, frame_min
+from ..utils.frames import (
+    NULL_FRAME,
+    frame_add,
+    frame_diff,
+    frame_gt,
+    frame_le,
+    frame_lt,
+    frame_min,
+)
 from .events import (
     DesyncDetected,
     DesyncDetection,
@@ -265,7 +273,6 @@ class P2PSession:
                 pending_fi = f
         if pending_fi != NULL_FRAME:
             new_confirmed = frame_min(new_confirmed, pending_fi)
-        from ..utils.frames import frame_diff
         if frame_diff(self.current_frame, new_confirmed) > self._max_prediction:
             self._staged.clear()
             raise PredictionThresholdError()

@@ -50,10 +50,13 @@ def test_lossy_network_stays_in_sync(loss, latency, jitter):
         time.sleep(0.002)
     assert all(r.session.current_state() == SessionState.RUNNING for r in runners)
 
+    # jittered host ticks: dt varies per peer per tick (uneven frame pacing
+    # exercises the accumulator + time-sync paths alongside loss/reorder)
+    dt_rng = np.random.default_rng(7)
     for _ in range(200):
         net.deliver()
         for r in runners:
-            r.update(DT)
+            r.update(DT * float(dt_rng.uniform(0.5, 1.5)))
     # both made progress despite loss
     assert all(r.frame >= 150 for r in runners)
     # compare only at a frame both peers have CONFIRMED (a frame still inside
