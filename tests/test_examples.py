@@ -102,7 +102,7 @@ def test_spectator_cli_follows_host_pair():
          "--local-port", str(ports[0]),
          "--players", "local", f"127.0.0.1:{ports[1]}",
          "--spectators", f"127.0.0.1:{ports[2]}",
-         "--frames", "150"],
+         "--frames", "120"],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True,
     )
@@ -110,7 +110,7 @@ def test_spectator_cli_follows_host_pair():
         [sys.executable, "examples/box_game_p2p.py",
          "--local-port", str(ports[1]),
          "--players", f"127.0.0.1:{ports[0]}", "local",
-         "--frames", "150"],
+         "--frames", "120"],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True,
     )
@@ -118,14 +118,16 @@ def test_spectator_cli_follows_host_pair():
         [sys.executable, "examples/box_game_spectator.py",
          "--local-port", str(ports[2]),
          "--host", f"127.0.0.1:{ports[0]}",
-         "--frames", "100"],
+         "--frames", "60"],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True,
     )
     try:
-        s_out, _ = spec.communicate(timeout=240)
-        h_out, _ = host.communicate(timeout=60)
-        p_out, _ = peer.communicate(timeout=60)
+        # generous timeouts: three interpreters jit-compiling concurrently
+        # under full-suite CPU contention are slow to reach real-time pacing
+        s_out, _ = spec.communicate(timeout=480)
+        h_out, _ = host.communicate(timeout=120)
+        p_out, _ = peer.communicate(timeout=120)
     finally:
         for p in (host, peer, spec):
             if p.poll() is None:
