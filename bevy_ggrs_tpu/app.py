@@ -22,6 +22,7 @@ import numpy as np
 from .ops.resim import (
     StepCtx,
     make_advance_fn,
+    make_canonical_resim_fn,
     make_resim_fn,
     make_speculate_fn,
 )
@@ -43,12 +44,18 @@ class App:
         input_dtype=np.uint8,
         seed: int = 0,
         retention: int = 16,
+        canonical_depth: "Optional[int]" = None,
     ):
         self.num_players = num_players
         self.fps = fps
         # despawn-retirement horizon (frames); must be >= the session's
         # max prediction window / check distance (see ops/resim.py docstring)
         self.retention = retention
+        # bit-determinism mode: run EVERY advance through one fixed-length
+        # compiled program (see ops/resim.resim_padded).  Required for float
+        # sims whose peers must stay bit-identical under differing rollback
+        # histories; None = per-length programs (fastest dispatch)
+        self.canonical_depth = canonical_depth
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
         self.seed = seed
@@ -152,10 +159,29 @@ class App:
 
     @cached_property
     def advance_fn(self):
+        if self.canonical_depth is not None:
+            # route single advances through the SAME canonical program
+            resim = self.resim_fn
+
+            def fn(state, inputs, status, frame, _unused=None):
+                import numpy as np
+
+                final, stacked, checks = resim(
+                    state, np.asarray(inputs)[None], np.asarray(status)[None],
+                    frame - 1,
+                )
+                return final, checks[0]
+
+            return fn
         return make_advance_fn(self.reg, self.step, self.fps, self.seed, self.retention)
 
     @cached_property
     def resim_fn(self):
+        if self.canonical_depth is not None:
+            return make_canonical_resim_fn(
+                self.reg, self.step, self.fps, self.seed, self.retention,
+                self.canonical_depth,
+            )
         return make_resim_fn(self.reg, self.step, self.fps, self.seed, self.retention)
 
     @cached_property

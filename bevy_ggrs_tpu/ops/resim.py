@@ -129,6 +129,87 @@ def resim(
     return final, stacked, checks
 
 
+def resim_padded(
+    reg: Registry,
+    step_fn: StepFn,
+    state: WorldState,
+    inputs_seq,  # [k_max, num_players, *input_shape]
+    status_seq,  # int8[k_max, num_players]
+    start_frame,
+    n_real,  # traced scalar: how many leading frames actually advance
+    retention: int,
+    fps: int,
+    seed: int = 0,
+):
+    """Fixed-length scan with masked padding — the bit-determinism program.
+
+    XLA compiles a DIFFERENT program per scan length, and program variants
+    may round the same step differently (FMA contraction/fusion differ; a
+    measured 56/300 random single-steps mismatched between the k=1 and k=8
+    CPU programs).  Peers whose rollback depths differ then drift in low
+    float bits and desync.  Running EVERY advance through one fixed-k_max
+    program — real frames first, padded frames passing state through
+    unchanged — makes the arithmetic identical regardless of segmentation.
+    See docs/determinism.md ("One program to advance them all")."""
+    start_frame = jnp.asarray(start_frame, jnp.int32)
+    n_real = jnp.asarray(n_real, jnp.int32)
+
+    def body(carry, x):
+        st, f, i = carry
+        inp, stat = x
+        nf = f + 1
+        st2 = advance(reg, step_fn, st, inp, stat, nf, retention, fps, seed)
+        take = i < n_real
+        st = jax.tree.map(lambda a, b: jnp.where(take, a, b), st2, st)
+        f = jnp.where(take, nf, f)
+        return (st, f, i + 1), (st, world_checksum(reg, st))
+
+    (final, _, _), (stacked, checks) = jax.lax.scan(
+        body, (state, start_frame, jnp.int32(0)), (inputs_seq, status_seq)
+    )
+    return final, stacked, checks
+
+
+def make_canonical_resim_fn(reg: Registry, step_fn: StepFn, fps: int,
+                            seed: int = 0, retention: int = 16,
+                            k_max: int = 16):
+    """jit of :func:`resim_padded` — ONE compiled program for every advance,
+    wrapped to the plain resim_fn signature (pads, dispatches, trims)."""
+    import numpy as np
+
+    @jax.jit
+    def fn(state, inputs_seq, status_seq, start_frame, n_real):
+        return resim_padded(
+            reg, step_fn, state, inputs_seq, status_seq, start_frame, n_real,
+            retention, fps, seed,
+        )
+
+    def wrapped(state, inputs_seq, status_seq, start_frame, _unused=None):
+        inputs_seq = np.asarray(inputs_seq)
+        status_seq = np.asarray(status_seq)
+        k = inputs_seq.shape[0]
+        if k > k_max:
+            raise ValueError(
+                f"resim depth {k} exceeds canonical_depth {k_max}; raise "
+                "App(canonical_depth=...) above every session window"
+            )
+        pad = k_max - k
+        if pad:
+            inputs_seq = np.concatenate(
+                [inputs_seq, np.repeat(inputs_seq[-1:], pad, axis=0)]
+            )
+            status_seq = np.concatenate(
+                [status_seq, np.repeat(status_seq[-1:], pad, axis=0)]
+            )
+        final, stacked, checks = fn(state, inputs_seq, status_seq, start_frame, k)
+        if pad:
+            stacked = jax.tree.map(lambda a: a[:k], stacked)
+            checks = checks[:k]
+        return final, stacked, checks
+
+    return wrapped
+
+
 def make_advance_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
                     retention: int = 16):
     """jit-compiled single-frame advance returning (state, checksum)."""

@@ -65,6 +65,13 @@ class GgrsRunner:
         self.events: List = []
         self.session = None
         self.stalled_frames = 0  # PredictionThreshold skips (observability)
+        if speculation is not None and app.canonical_depth is not None:
+            raise ValueError(
+                "speculation evaluates branches in a vmapped program variant "
+                "whose float rounding may differ from the canonical program; "
+                "bit-determinism mode (canonical_depth) therefore excludes "
+                "the speculative cache for now"
+            )
         self.spec_cache = (
             SpeculationCache(app, speculation) if speculation is not None else None
         )

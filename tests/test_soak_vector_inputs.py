@@ -1,0 +1,107 @@
+"""Lossy-network soak with multi-byte (int16[2]) inputs: input rows larger
+than a byte exercise packet payload slicing and redundancy across chunk
+boundaries; peers must still agree at confirmed frames."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from bevy_ggrs_tpu import App, GgrsRunner, PlayerType, SessionBuilder, SessionState
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.snapshot import active_mask, spawn
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+
+
+def make_stick_app():
+    # canonical_depth: this model's arithmetic (int->float scale + add) hits
+    # XLA program-variant rounding differences (FMA/fusion), so cross-peer
+    # bit-determinism REQUIRES the single fixed-length program
+    # (docs/determinism.md); without it this soak desyncs ~75% of runs.
+    app = App(num_players=2, capacity=4, input_shape=(2,), input_dtype=np.int16,
+              canonical_depth=12)
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("handle", (), jnp.int32, checksum=True)
+
+    def step(world, ctx):
+        h = world.comps["handle"]
+        m = active_mask(world) & world.has["handle"]
+        stick = ctx.inputs.astype(jnp.float32) / 1000.0
+        delta = stick[jnp.clip(h, 0, 1)]
+        pos = world.comps["pos"] + jnp.where(m[:, None], delta, 0.0)
+        return dataclasses.replace(world, comps={**world.comps, "pos": pos})
+
+    def setup(world):
+        for h in range(2):
+            world, _ = spawn(app.reg, world, {"pos": np.zeros(2), "handle": h})
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+    return app
+
+
+def test_vector_inputs_survive_loss_and_reorder():
+    net = ChannelNetwork(latency_hops=2, loss=0.2, jitter_hops=3, seed=11)
+    socks = [net.endpoint("a"), net.endpoint("b")]
+    rngs = [np.random.default_rng(i) for i in range(2)]
+    runners = []
+    for i in range(2):
+        app = make_stick_app()
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(2)
+            .with_disconnect_timeout(60.0)
+            .with_disconnect_notify_delay(30.0)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a")
+        )
+        session = b.start_p2p_session(socks[i])
+        runners.append(
+            GgrsRunner(
+                app, session,
+                read_inputs=lambda hs, i=i: {
+                    h: rngs[i].integers(-500, 500, 2).astype(np.int16) for h in hs
+                },
+            )
+        )
+
+    import time
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.002)
+    assert all(r.session.current_state() == SessionState.RUNNING for r in runners)
+
+    for _ in range(150):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+    assert all(r.frame >= 100 for r in runners)
+
+    f = None
+    for _ in range(40):
+        conf = min(r.session.confirmed_frame() for r in runners)
+        shared = [
+            fr
+            for fr in set(runners[0].ring.frames()) & set(runners[1].ring.frames())
+            if fr <= conf
+        ]
+        if shared:
+            f = max(shared)
+            break
+        net.deliver()
+        (runners[0] if runners[0].frame <= runners[1].frame else runners[1]).update(DT)
+    assert f is not None
+    assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
+        runners[1].ring.peek(f)[1]
+    )
+    # and motion actually happened (inputs flowed)
+    assert float(np.abs(np.asarray(runners[0].world.comps["pos"])).max()) > 0.1
