@@ -96,8 +96,14 @@ def bench_device():
     sdt = time.perf_counter() - t0
     spec_fps = SPEC_BRANCHES * DEPTH * ITERS / sdt
 
+    # canonical bit-determinism mode (fixed k=16 program): the safe float
+    # configuration's throughput, reported alongside the fast path
+    capp = stress.make_app(N_ENTITIES)
+    capp.canonical_depth = 16
+    fps_canon = _bench_layout(capp)
+
     platform = jax.devices()[0].platform
-    return fps, spec_fps, platform, layout, fps_mat, fps_soa
+    return fps, spec_fps, platform, layout, fps_mat, fps_soa, fps_canon
 
 
 def bench_numpy_baseline():
@@ -119,7 +125,7 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    device_fps, spec_fps, platform, layout, fps_mat, fps_soa = bench_device()
+    device_fps, spec_fps, platform, layout, fps_mat, fps_soa, fps_canon = bench_device()
     cpu_fps = bench_numpy_baseline()
     result = {
         "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
@@ -131,6 +137,7 @@ def main():
         "best_layout": layout,
         "vec3_layout_fps": round(fps_mat, 1),
         "scalar_columns_fps": round(fps_soa, 1),
+        "canonical_mode_fps": round(fps_canon, 1),
         "platform": platform,
         "entities": N_ENTITIES,
         "rollback_depth": DEPTH,

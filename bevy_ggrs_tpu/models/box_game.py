@@ -89,14 +89,19 @@ def setup(app: App):
     return fn
 
 
-def make_app(num_players: int = 2, capacity: int = 8, fps: int = 60) -> App:
-    """Build the box_game App (pos/vel/handle columns, checksummed)."""
+def make_app(num_players: int = 2, capacity: int = 8, fps: int = 60,
+             canonical_depth=None) -> App:
+    """Build the box_game App (pos/vel/handle columns, checksummed).
+
+    Pass ``canonical_depth`` for cross-peer bit-determinism hardening of the
+    float physics (docs/determinism.md "One program to advance them all")."""
     app = App(
         num_players=num_players,
         capacity=capacity,
         fps=fps,
         input_shape=(),
         input_dtype=np.uint8,
+        canonical_depth=canonical_depth,
     )
     app.rollback_component("pos", (2,), jnp.float32, checksum=True)
     app.rollback_component("vel", (2,), jnp.float32, checksum=True)

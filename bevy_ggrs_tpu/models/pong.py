@@ -120,10 +120,13 @@ def step(world: WorldState, ctx: StepCtx) -> WorldState:
 _REG = [None]  # registry handle for spawn_many inside the jitted step
 
 
-def make_app(fps: int = 60, capacity: int = 16) -> App:
-    """Build the pong App (paddle entities, score/serve resources)."""
+def make_app(fps: int = 60, capacity: int = 16, canonical_depth=None) -> App:
+    """Build the pong App (paddle entities, score/serve resources).
+
+    ``canonical_depth``: see docs/determinism.md (float bit-determinism)."""
     app = App(num_players=2, capacity=capacity, fps=fps,
-              input_shape=(), input_dtype=np.uint8)
+              input_shape=(), input_dtype=np.uint8,
+              canonical_depth=canonical_depth)
     app.rollback_component("pos", (2,), jnp.float32, checksum=True)
     app.rollback_component("vel", (2,), jnp.float32, checksum=True)
     app.rollback_component("kind", (), jnp.int32, checksum=True)
