@@ -22,6 +22,7 @@ import numpy as np
 from .ops.resim import (
     StepCtx,
     make_advance_fn,
+    make_canonical_branched_fn,
     make_canonical_resim_fn,
     make_resim_fn,
     make_speculate_fn,
@@ -45,6 +46,7 @@ class App:
         seed: int = 0,
         retention: int = 16,
         canonical_depth: "Optional[int]" = None,
+        canonical_branches: "Optional[int]" = None,
     ):
         self.num_players = num_players
         self.fps = fps
@@ -56,6 +58,14 @@ class App:
         # sims whose peers must stay bit-identical under differing rollback
         # histories; None = per-length programs (fastest dispatch)
         self.canonical_depth = canonical_depth
+        # canonical-branched mode: the single program is additionally vmapped
+        # over a fixed number of branch lanes (lane 0 = real inputs, others =
+        # speculative hedges or dummies).  Lets speculation coexist with
+        # bit-determinism — but the (depth, branches) shape is then a
+        # LOBBY-WIDE constant: every peer must dispatch the same program
+        self.canonical_branches = canonical_branches
+        if canonical_branches is not None and canonical_depth is None:
+            raise ValueError("canonical_branches requires canonical_depth")
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
         self.seed = seed
@@ -176,13 +186,62 @@ class App:
         return make_advance_fn(self.reg, self.step, self.fps, self.seed, self.retention)
 
     @cached_property
+    def branched_fn(self):
+        """Raw canonical-branched program (canonical_branches mode):
+        fn(state, inputs[B, K, P, ...], status[B, K, P], start_frame,
+        n_real[B]) -> per-lane (final, stacked, checks)."""
+        if self.canonical_branches is None:
+            raise RuntimeError("App was not configured with canonical_branches")
+        return make_canonical_branched_fn(
+            self.reg, self.step, self.fps, self.seed, self.retention,
+            self.canonical_depth, self.canonical_branches,
+        )
+
+    @cached_property
     def resim_fn(self):
+        if self.canonical_branches is not None:
+            return self._branched_resim_wrapper()
         if self.canonical_depth is not None:
             return make_canonical_resim_fn(
                 self.reg, self.step, self.fps, self.seed, self.retention,
                 self.canonical_depth,
             )
         return make_resim_fn(self.reg, self.step, self.fps, self.seed, self.retention)
+
+    def _branched_resim_wrapper(self):
+        """resim_fn facade over the branched program: lane 0 carries the real
+        inputs, other lanes duplicate it (dummy hedges) so non-speculating
+        peers dispatch the exact same program as speculating ones."""
+        fn = self.branched_fn
+        B, K = self.canonical_branches, self.canonical_depth
+
+        def wrapped(state, inputs_seq, status_seq, start_frame, _unused=None):
+            import jax as _jax
+
+            inputs_seq = np.asarray(inputs_seq)
+            status_seq = np.asarray(status_seq)
+            k = inputs_seq.shape[0]
+            if k > K:
+                raise ValueError(
+                    f"resim depth {k} exceeds canonical_depth {K}"
+                )
+            pad = K - k
+            if pad:
+                inputs_seq = np.concatenate(
+                    [inputs_seq, np.repeat(inputs_seq[-1:], pad, axis=0)]
+                )
+                status_seq = np.concatenate(
+                    [status_seq, np.repeat(status_seq[-1:], pad, axis=0)]
+                )
+            ib = np.broadcast_to(inputs_seq[None], (B, *inputs_seq.shape)).copy()
+            sb = np.broadcast_to(status_seq[None], (B, *status_seq.shape)).copy()
+            n_real = np.full((B,), k, np.int32)
+            finals, stacked, checks = fn(state, ib, sb, start_frame, n_real)
+            lane0 = lambda t: _jax.tree.map(lambda a: a[0], t)
+            stacked0 = _jax.tree.map(lambda a: a[0, :k], stacked)
+            return lane0(finals), stacked0, checks[0, :k]
+
+        return wrapped
 
     @cached_property
     def speculate_fn(self):

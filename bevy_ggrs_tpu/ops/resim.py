@@ -210,6 +210,30 @@ def make_canonical_resim_fn(reg: Registry, step_fn: StepFn, fps: int,
     return wrapped
 
 
+def make_canonical_branched_fn(reg: Registry, step_fn: StepFn, fps: int,
+                               seed: int = 0, retention: int = 16,
+                               k_max: int = 16, branches: int = 8):
+    """ONE fixed [branches, k_max] vmapped program for every dispatch — the
+    bit-determinism-safe speculation shape.
+
+    Branch 0 carries the real inputs (its lane is the authoritative result);
+    lanes 1.. evaluate hedge candidates in the same dispatch.  vmap lanes are
+    independent, so branch 0's arithmetic is one fixed machine code
+    regardless of what the other lanes compute — canonical-mode determinism
+    AND speculative hedging together (docs/determinism.md)."""
+
+    @jax.jit
+    def fn(state, inputs_b, status_b, start_frame, n_real):
+        return jax.vmap(
+            lambda inp, stat, nr: resim_padded(
+                reg, step_fn, state, inp, stat, start_frame, nr,
+                retention, fps, seed,
+            )
+        )(inputs_b, status_b, n_real)
+
+    return fn
+
+
 def make_advance_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
                     retention: int = 16):
     """jit-compiled single-frame advance returning (state, checksum)."""

@@ -89,6 +89,29 @@ class SpeculationCache:
                 break
             del self._cache[f]
 
+    def fill_from_branched(self, start_frame: int, cands: np.ndarray,
+                           stacked_b, checks_b, offset: int, depth_eff: int) -> None:
+        """Store hedge-lane outputs of a canonical-branched dispatch.
+
+        ``stacked_b``/``checks_b`` carry a leading branch axis ALIGNED with
+        ``cands`` (hedge lanes only); each lane's frames [offset:] hold the
+        candidate-driven continuation."""
+        if depth_eff <= 0 or cands.shape[0] == 0:
+            return
+        entry = {}
+        for b in range(cands.shape[0]):
+            key = np.ascontiguousarray(cands[b]).tobytes()
+            if key in entry:
+                continue  # duplicate candidate (padding lanes)
+            stacked_slice = jax_tree_slice_range(stacked_b, b, offset, depth_eff)
+            entry[key] = (stacked_slice, checks_b[b, offset:offset + depth_eff])
+        self.branches_evaluated += cands.shape[0] * depth_eff
+        self._cache[start_frame] = (depth_eff, entry)
+        for f in sorted(self._cache):
+            if len(self._cache) <= self.config.max_cached_frames:
+                break
+            del self._cache[f]
+
     def lookup_seq(self, start_frame: int, inputs_seq: np.ndarray) -> Optional[Tuple]:
         """Longest cached prefix for advancing ``start_frame`` with the frame
         sequence ``inputs_seq [k, P, *shape]``.
@@ -134,6 +157,13 @@ def jax_tree_slice(tree, idx):
     import jax
 
     return jax.tree.map(lambda a: a[idx], tree)
+
+
+def jax_tree_slice_range(tree, idx, start, length):
+    """tree_map(a[idx, start:start+length]) over a branch-stacked pytree."""
+    import jax
+
+    return jax.tree.map(lambda a: a[idx, start:start + length], tree)
 
 
 def pad_candidates(num_players: int, predicted_handles, values) -> Callable:

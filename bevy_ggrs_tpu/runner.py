@@ -65,12 +65,16 @@ class GgrsRunner:
         self.events: List = []
         self.session = None
         self.stalled_frames = 0  # PredictionThreshold skips (observability)
-        if speculation is not None and app.canonical_depth is not None:
+        if (
+            speculation is not None
+            and app.canonical_depth is not None
+            and app.canonical_branches is None
+        ):
             raise ValueError(
-                "speculation evaluates branches in a vmapped program variant "
-                "whose float rounding may differ from the canonical program; "
-                "bit-determinism mode (canonical_depth) therefore excludes "
-                "the speculative cache for now"
+                "speculation under bit-determinism requires the canonical-"
+                "branched program: set App(canonical_branches=M+1) so hedges "
+                "run inside the SAME fixed [branches, depth] dispatch every "
+                "peer uses (docs/determinism.md)"
             )
         self.spec_cache = (
             SpeculationCache(app, speculation) if speculation is not None else None
@@ -309,15 +313,23 @@ class GgrsRunner:
         last_adv_src = self.world
         if skip == k and skip >= 2:
             last_adv_src = cache_states(skip - 2)
+        use_branched = (
+            self.spec_cache is not None and self.app.canonical_branches is not None
+        )
         if k - skip > 0:
             self.device_dispatches += 1
             self.rollback_frames += max(k - skip - 1, 0)
             with span("AdvanceWorld"):
                 inputs = np.stack([a.inputs for a in adv[skip:]])
                 status = np.stack([a.status for a in adv[skip:]])
-                final, stacked, checks = self.app.resim_fn(
-                    self.world, inputs, status, self.frame
-                )
+                if use_branched:
+                    final, stacked, checks = self._dispatch_branched(
+                        inputs, status, adv[-1]
+                    )
+                else:
+                    final, stacked, checks = self.app.resim_fn(
+                        self.world, inputs, status, self.frame
+                    )
                 if k - skip >= 2:
                     last_adv_src = slice_frame(stacked, k - skip - 2)
                 self.world = final
@@ -340,15 +352,61 @@ class GgrsRunner:
                 self.ring.push(r.frame, (stored, cs))
                 r.cell.save(r.frame, _provider(cs))
         # hedge the live frame: if its inputs were (partly) predicted, fan out
-        # candidate branches for the same transition
+        # candidate branches for the same transition (the branched program
+        # already did this inside its own dispatch)
         if (
-            self.spec_cache is not None
+            not (self.spec_cache is not None and self.app.canonical_branches)
+            and self.spec_cache is not None
             and k > 0
             and np.any(adv[-1].status == InputStatus.PREDICTED)
         ):
             self.spec_cache.speculate(
                 last_adv_src, self.frame - 1, adv[-1].inputs
             )
+
+    def _dispatch_branched(self, inputs, status, last_adv):
+        """One canonical [B, K] dispatch: lane 0 = the real batch; hedge
+        lanes replay the real prefix then hold a candidate input from the
+        last transition onward (cache entries come out of the same program
+        every peer runs — bit-determinism preserved)."""
+        import jax as _jax
+
+        app = self.app
+        B, K = app.canonical_branches, app.canonical_depth
+        k = inputs.shape[0]
+        if k > K:
+            raise ValueError(f"resim depth {k} exceeds canonical_depth {K}")
+        pad = K - k
+        inputs_p = np.concatenate([inputs, np.repeat(inputs[-1:], pad, axis=0)])             if pad else inputs
+        status_p = np.concatenate([status, np.repeat(status[-1:], pad, axis=0)])             if pad else status
+        ib = np.broadcast_to(inputs_p[None], (B, *inputs_p.shape)).copy()
+        sb = np.broadcast_to(status_p[None], (B, *status_p.shape)).copy()
+        n_real = np.full((B,), k, np.int32)
+        hedging = bool(np.any(last_adv.status == InputStatus.PREDICTED))
+        cands = None
+        if hedging:
+            cands = np.asarray(
+                self.spec_cache.config.candidates_fn(last_adv.inputs),
+                app.input_dtype,
+            )[: B - 1]
+            for b in range(cands.shape[0]):
+                ib[1 + b, k - 1:] = cands[b]  # real prefix, candidate held
+                sb[1 + b, k - 1:] = 0  # hedges evaluate as confirmed
+                n_real[1 + b] = K
+        finals, stacked, checks = app.branched_fn(
+            self.world, ib, sb, self.frame, n_real
+        )
+        if hedging and cands is not None and cands.shape[0] > 0:
+            m = cands.shape[0]
+            hedge_stacked = _jax.tree.map(lambda a: a[1:1 + m], stacked)
+            self.spec_cache.fill_from_branched(
+                frame_add(self.frame, k - 1), cands,
+                hedge_stacked, np.asarray(checks[1:1 + m]),
+                offset=k - 1, depth_eff=K - (k - 1),
+            )
+        final0 = _jax.tree.map(lambda a: a[0], finals)
+        stacked0 = _jax.tree.map(lambda a: a[0, :k], stacked)
+        return final0, stacked0, checks[0, :k]
 
 
 def _provider(cs):

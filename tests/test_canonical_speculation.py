@@ -1,0 +1,127 @@
+"""Canonical-branched speculation: hedging + bit-determinism together.
+
+The same lossy/reordered vector-input scenario that desyncs under
+per-length programs must stay in sync when BOTH peers dispatch the one
+canonical [branches, depth] program — with one peer actively hedging (cache
+hits) and the other running dummy lanes."""
+
+import dataclasses
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    App,
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    SpeculationConfig,
+)
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.snapshot import active_mask, spawn
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+B, K = 4, 12
+
+
+def make_app():
+    app = App(num_players=2, capacity=4, input_shape=(), input_dtype=np.uint8,
+              canonical_depth=K, canonical_branches=B)
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("handle", (), jnp.int32, checksum=True)
+
+    def step(world, ctx):
+        h = world.comps["handle"]
+        m = active_mask(world) & world.has["handle"]
+        v = ctx.inputs.astype(jnp.float32) / 7.0 - 1.0  # division: FMA-bait
+        delta = jnp.stack([v, -v], axis=-1)[jnp.clip(h, 0, 1)]
+        pos = world.comps["pos"] + jnp.where(m[:, None], delta, 0.0)
+        return dataclasses.replace(world, comps={**world.comps, "pos": pos})
+
+    def setup(world):
+        for h in range(2):
+            world, _ = spawn(app.reg, world, {"pos": np.zeros(2), "handle": h})
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+    return app
+
+
+def test_hedged_and_plain_peers_stay_bit_identical():
+    net = ChannelNetwork(latency_hops=3, loss=0.1, jitter_hops=2, seed=5)
+    socks = [net.endpoint("a"), net.endpoint("b")]
+    runners = []
+    for i in range(2):
+        app = make_app()
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .with_disconnect_timeout(60.0)
+            .with_disconnect_notify_delay(30.0)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a")
+        )
+        session = b.start_p2p_session(socks[i])
+        # only peer 0 hedges; peer 1 runs the same program with dummy lanes
+        spec = (
+            SpeculationConfig(
+                candidates_fn=lambda used: np.arange(B - 1, dtype=np.uint8)[
+                    :, None
+                ].repeat(2, axis=1),
+            )
+            if i == 0
+            else None
+        )
+        tick = [0]
+
+        def read_inputs(handles, i=i, tick=tick):
+            tick[0] += 1
+            val = (tick[0] // 6) % 3  # cycles 0,1,2 — hedged by candidates
+            return {h: np.uint8(val) for h in handles}
+
+        runners.append(
+            GgrsRunner(app, session, read_inputs=read_inputs, speculation=spec)
+        )
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.002)
+    assert all(r.session.current_state() == SessionState.RUNNING for r in runners)
+
+    for _ in range(150):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+
+    s0 = runners[0].stats()
+    assert s0["rollbacks"] > 0
+    assert s0["speculation_hits"] > 0, f"hedging never hit: {s0}"
+
+    # bit-identical at confirmed frames despite asymmetric hedging
+    f = None
+    for _ in range(40):
+        conf = min(r.session.confirmed_frame() for r in runners)
+        shared = [
+            fr
+            for fr in set(runners[0].ring.frames()) & set(runners[1].ring.frames())
+            if fr <= conf
+        ]
+        if shared:
+            f = max(shared)
+            break
+        net.deliver()
+        (runners[0] if runners[0].frame <= runners[1].frame else runners[1]).update(DT)
+    assert f is not None
+    assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
+        runners[1].ring.peek(f)[1]
+    ), "hedged peer diverged from plain peer"
