@@ -7,6 +7,7 @@ from .mesh import (
     shard_world,
     make_sharded_resim_fn,
     make_sharded_speculate_fn,
+    make_sharded_canonical_fn,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "shard_world",
     "make_sharded_resim_fn",
     "make_sharded_speculate_fn",
+    "make_sharded_canonical_fn",
 ]

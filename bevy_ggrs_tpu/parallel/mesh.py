@@ -112,3 +112,24 @@ def make_sharded_speculate_fn(app: App, mesh: Mesh):
         return fn(world, inputs_branches, status_branches, start_frame)
 
     return wrapped
+
+
+def make_sharded_canonical_fn(app: App, mesh: Mesh):
+    """The canonical [branches, depth] program sharded over the mesh:
+    entities over "data", branch lanes over "spec" — the full TPU-first
+    shape (bit-determinism + speculation + multi-chip in one dispatch).
+
+    Signature matches ``app.branched_fn``:
+    fn(world, inputs[B, K, P, ...], status[B, K, P], start_frame, n_real[B]).
+    """
+    fn = app.branched_fn  # jitted; sharding comes from input placement
+
+    def wrapped(world, inputs_b, status_b, start_frame, n_real):
+        world = shard_world(app, mesh, world)
+        spec = lambda nd: NamedSharding(mesh, P(SPEC_AXIS, *([None] * (nd - 1))))
+        inputs_b = jax.device_put(jax.numpy.asarray(inputs_b), spec(np.ndim(inputs_b)))
+        status_b = jax.device_put(jax.numpy.asarray(status_b), spec(np.ndim(status_b)))
+        n_real = jax.device_put(jax.numpy.asarray(n_real), spec(1))
+        return fn(world, inputs_b, status_b, start_frame, n_real)
+
+    return wrapped

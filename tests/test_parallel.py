@@ -61,3 +61,27 @@ def test_shard_world_places_on_mesh():
     w = shard_world(app, mesh, world)
     shard_devs = {s.device for s in w.comps["pos"].addressable_shards}
     assert len(shard_devs) == 8
+
+
+def test_sharded_canonical_branched_matches_single_device():
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_canonical_speculation import make_app, B, K
+
+    from bevy_ggrs_tpu.parallel import make_sharded_canonical_fn
+
+    app = make_app()
+    world = app.init_state()
+    rng = np.random.default_rng(3)
+    ib = rng.integers(0, 3, (B, K, 2)).astype(np.uint8)
+    sb = np.zeros((B, K, 2), np.int8)
+    n_real = np.full((B,), K, np.int32)
+
+    _, _, checks_single = app.branched_fn(world, ib, sb, 0, n_real)
+
+    mesh = make_mesh(n_data=2, n_spec=4)
+    sharded = make_sharded_canonical_fn(app, mesh)
+    _, _, checks_sharded = sharded(world, ib, sb, 0, n_real)
+
+    assert np.array_equal(np.asarray(checks_single), np.asarray(checks_sharded))
