@@ -81,17 +81,21 @@ def bench_device():
     layout = "scalar_columns" if fps_soa >= fps_mat else "vec3_columns"
 
     # speculative fan-out (BASELINE config 5: 4 players x 16 branches x
-    # 8 frames, over the 10k-entity world)
+    # 8 frames over the 10k-entity world) via the CANONICAL branched program
+    # — the shipped bit-determinism + hedging dispatch shape
     app = stress.make_app(N_ENTITIES, num_players=4)
+    app.canonical_depth = DEPTH
+    app.canonical_branches = SPEC_BRANCHES
     world = app.init_state()
-    spec = app.speculate_fn
+    spec = app.branched_fn
     bi = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.uint8))
     bs = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.int8))
-    out = spec(world, bi, bs, 0)
+    nr = jax.device_put(jnp.full((SPEC_BRANCHES,), DEPTH, jnp.int32))
+    out = spec(world, bi, bs, 0, nr)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(ITERS):
-        out = spec(world, bi, bs, i)
+        out = spec(world, bi, bs, i, nr)
     jax.block_until_ready(out)
     sdt = time.perf_counter() - t0
     spec_fps = SPEC_BRANCHES * DEPTH * ITERS / sdt
