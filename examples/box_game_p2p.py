@@ -46,7 +46,11 @@ def main():
     ap.add_argument("--frames", type=int, default=600)
     args = ap.parse_args()
 
-    app = box_game.make_app(num_players=len(args.players), fps=args.fps)
+    # canonical_depth: networked float play defaults to the bit-determinism
+    # program (docs/determinism.md) — rollback segmentation differences
+    # between peers must not change rounding
+    app = box_game.make_app(num_players=len(args.players), fps=args.fps,
+                            canonical_depth=args.max_prediction + 2)
     sock = UdpNonBlockingSocket(args.local_port)
     b = (
         SessionBuilder.for_app(app)

@@ -41,7 +41,8 @@ def main():
                     help="hedge predicted remote inputs (branch cache)")
     args = ap.parse_args()
 
-    app = pong.make_app()
+    # networked play: bit-determinism program (docs/determinism.md)
+    app = pong.make_app(canonical_depth=10)
     b = SessionBuilder.for_app(app).with_input_delay(1)
 
     def read_inputs(handles):
