@@ -41,8 +41,11 @@ def main():
                     help="hedge predicted remote inputs (branch cache)")
     args = ap.parse_args()
 
-    # networked play: bit-determinism program (docs/determinism.md)
+    # networked play: bit-determinism program (docs/determinism.md); with
+    # --speculate the program gains fixed hedge lanes (canonical_branches)
     app = pong.make_app(canonical_depth=10)
+    if args.speculate:
+        app.canonical_branches = 4  # lane 0 real + 3 hedge candidates
     b = SessionBuilder.for_app(app).with_input_delay(1)
 
     def read_inputs(handles):
