@@ -165,6 +165,7 @@ class GgrsRunner:
         """Driver health counters (rollback frequency/depth, dispatches,
         stalls, speculation hit rate)."""
         return {
+            "overflow": bool(np.asarray(self.world.overflow)),
             "ticks": self.ticks,
             "rollbacks": self.rollbacks,
             "resimulated_frames": self.rollback_frames,

@@ -184,3 +184,20 @@ def test_ops_are_jittable():
     w = build(reg.init_state())
     assert int(active_count(w)) == 0
     assert int(w.next_id) == 1
+
+
+def test_cloned_entity_gets_fresh_id():
+    # EntityCloner regression analog (/root/reference/src/snapshot/
+    # rollback.rs:121-196): copying an entity's components into a new spawn
+    # must mint a NEW rollback id, never alias the source's identity
+    reg = make_reg()
+    w = reg.init_state()
+    w, src = spawn(reg, w, {"pos": jnp.array([3.0, 4.0]), "hp": 7})
+    clone_comps = {
+        "pos": w.comps["pos"][src],
+        "hp": w.comps["hp"][src],
+    }
+    w, dup = spawn(reg, w, clone_comps)
+    assert int(w.rollback_id[int(src)]) != int(w.rollback_id[int(dup)])
+    assert int(w.rollback_id[int(dup)]) == 1
+    assert jnp.allclose(w.comps["pos"][int(dup)], w.comps["pos"][int(src)])
