@@ -14,6 +14,7 @@ from .app import App, DEFAULT_FPS
 from .runner import GgrsRunner
 from .ops.resim import StepCtx, select_branch, slice_frame
 from .ops.speculation import SpeculationConfig, SpeculationCache, pad_candidates
+from .ops.variant_probe import probe_program_variants, VariantProbeReport
 from .session import (
     SyncTestSession,
     P2PSession,

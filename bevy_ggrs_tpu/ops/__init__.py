@@ -1,3 +1,4 @@
+from .variant_probe import probe_program_variants, VariantProbeReport
 from .resim import (
     StepCtx,
     advance,
@@ -10,6 +11,8 @@ from .resim import (
 )
 
 __all__ = [
+    "probe_program_variants",
+    "VariantProbeReport",
     "StepCtx",
     "advance",
     "resim",

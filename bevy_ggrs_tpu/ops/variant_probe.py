@@ -1,0 +1,117 @@
+"""Program-variant stability probe — does this model need canonical mode?
+
+XLA compiles a different executable per resim length; executables may round
+the same step differently (FMA contraction / fusion — docs/determinism.md
+"One program to advance them all").  This probe measures it for a concrete
+App: it drives the model's own step through the k=1 and k=K programs over
+randomized reachable-ish states and inputs and bit-compares the results.
+
+Any mismatch means peers with different rollback histories WILL drift —
+configure ``App(canonical_depth=...)`` (and ``canonical_branches`` if
+hedging).  Zero mismatches is strong evidence of stability for the sampled
+distribution, not a proof; integer/fixed-point models are stable by
+construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class VariantProbeReport:
+    """Result of :func:`probe_program_variants`."""
+
+    trials: int
+    mismatching_trials: int
+    first_example: Optional[dict]  # {"leaf", "a", "b"} for the report
+    checked_lengths: tuple
+
+    @property
+    def stable(self) -> bool:
+        return self.mismatching_trials == 0
+
+    def summary(self) -> str:
+        if self.stable:
+            return (
+                f"stable: {self.trials} random trials bit-identical across "
+                f"scan lengths {self.checked_lengths} (no canonical_depth "
+                "needed for the sampled distribution)"
+            )
+        return (
+            f"UNSTABLE: {self.mismatching_trials}/{self.trials} trials "
+            f"differ across scan lengths {self.checked_lengths} — configure "
+            "App(canonical_depth=...) or peers will desync "
+            "(docs/determinism.md)"
+        )
+
+
+def probe_program_variants(
+    app,
+    trials: int = 200,
+    k_long: int = 8,
+    seed: int = 0,
+    warmup_frames: int = 16,
+) -> VariantProbeReport:
+    """Bit-compare the k=1 vs k=``k_long`` compiled programs on ``app``.
+
+    Each trial starts from a state reached by simulating ``warmup_frames``
+    random frames from init (so masks/spawns are realistic), then applies one
+    random input frame through both programs and compares every state leaf.
+    """
+    rng = np.random.default_rng(seed)
+    P = app.num_players
+    ishape = (P, *app.input_shape)
+
+    def rand_inputs(k):
+        info = np.iinfo(app.input_dtype) if np.issubdtype(
+            app.input_dtype, np.integer
+        ) else None
+        if info is not None:
+            lo, hi = max(info.min, -(2**15)), min(info.max, 2**15 - 1)
+            return rng.integers(lo, hi + 1, (k, *ishape)).astype(app.input_dtype)
+        return rng.standard_normal((k, *ishape)).astype(app.input_dtype)
+
+    status1 = np.zeros((1, P), np.int8)
+    mismatches = 0
+    first = None
+    base = app.init_state()
+    for t in range(trials):
+        # reach a plausible state
+        wk = rand_inputs(warmup_frames)
+        ws = np.zeros((warmup_frames, P), np.int8)
+        state, _, _ = app.resim_fn(base, wk, ws, 0)
+        inp = rand_inputs(1)
+        # k=1 program
+        one, _, _ = app.resim_fn(state, inp, status1, warmup_frames)
+        # k=k_long program, same first input then inert repeats of it; only
+        # the FIRST frame's output is compared
+        inp_long = np.repeat(inp, k_long, axis=0)
+        stat_long = np.zeros((k_long, P), np.int8)
+        _, stacked, _ = app.resim_fn(state, inp_long, stat_long, warmup_frames)
+        long_first = jax.tree.map(lambda a: a[0], stacked)
+        la, _ = jax.tree_util.tree_flatten_with_path(one)
+        lb, _ = jax.tree_util.tree_flatten_with_path(long_first)
+        for (pa, a), (_, b) in zip(la, lb):
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if not np.array_equal(a, b):
+                mismatches += 1
+                if first is None:
+                    idx = np.argwhere(a != b)
+                    first = {
+                        "leaf": jax.tree_util.keystr(pa),
+                        "a": a[tuple(idx[0])].item() if idx.size else None,
+                        "b": b[tuple(idx[0])].item() if idx.size else None,
+                    }
+                break
+    return VariantProbeReport(
+        trials=trials,
+        mismatching_trials=mismatches,
+        first_example=first,
+        checked_lengths=(1, k_long),
+    )

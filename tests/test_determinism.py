@@ -5,6 +5,7 @@ parity_check.py on real hardware) across CPU/TPU backends."""
 import dataclasses
 
 import jax
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -94,3 +95,53 @@ def test_canonical_mode_is_segmentation_stable():
     b = np.asarray(w_all.comps["pos"])
     c = np.asarray(w_mix.comps["pos"])
     assert np.array_equal(a, b) and np.array_equal(b, c)
+
+
+def test_variant_probe_flags_unstable_and_passes_stable():
+    import dataclasses
+    import sys
+
+    sys.path.insert(0, "tests")
+    from bevy_ggrs_tpu import App, probe_program_variants
+    from bevy_ggrs_tpu.models import fixed_point
+    from bevy_ggrs_tpu.snapshot import active_mask, spawn
+
+    # integer model: stable by construction
+    rep = probe_program_variants(fixed_point.make_app(), trials=20,
+                                 warmup_frames=4)
+    assert rep.stable, rep.summary()
+
+    # FMA-bait float model: must be flagged
+    app = App(num_players=2, capacity=4, input_shape=(2,), input_dtype=np.int16)
+    app.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app.rollback_component("handle", (), jnp.int32, checksum=True)
+
+    def step(world, ctx):
+        h = world.comps["handle"]
+        m = active_mask(world) & world.has["handle"]
+        stick = ctx.inputs.astype(jnp.float32) / 1000.0
+        delta = stick[jnp.clip(h, 0, 1)]
+        pos = world.comps["pos"] + jnp.where(m[:, None], delta, 0.0)
+        return dataclasses.replace(world, comps={**world.comps, "pos": pos})
+
+    def setup(world):
+        for h in range(2):
+            world, _ = spawn(app.reg, world, {"pos": np.zeros(2), "handle": h})
+        return world
+
+    app.set_step(step)
+    app.set_setup(setup)
+    rep = probe_program_variants(app, trials=40, warmup_frames=4)
+    assert not rep.stable
+    assert rep.first_example is not None
+
+    # ...and canonical mode makes the SAME model stable by construction
+    # (every length runs the one program; the probe then trivially passes)
+    app2 = App(num_players=2, capacity=4, input_shape=(2,), input_dtype=np.int16,
+               canonical_depth=8)
+    app2.rollback_component("pos", (2,), jnp.float32, checksum=True)
+    app2.rollback_component("handle", (), jnp.int32, checksum=True)
+    app2.set_step(step)
+    app2.set_setup(setup)
+    rep2 = probe_program_variants(app2, trials=20, warmup_frames=4)
+    assert rep2.stable, rep2.summary()
