@@ -19,6 +19,18 @@ python benches/criterion_equiv.py --iters 100
 echo "== cross-backend checksum parity =="
 python scripts/parity_check.py
 
+echo "== program-variant stability on this backend =="
+python - <<'PYEOF'
+from bevy_ggrs_tpu.ops.variant_probe import probe_program_variants
+from bevy_ggrs_tpu.models import box_game, pong, crowd, stress, fixed_point
+for name, mk in [("box_game", lambda: box_game.make_app(num_players=2)),
+                 ("pong", pong.make_app),
+                 ("crowd", lambda: crowd.make_app(n_per_team=64)),
+                 ("stress", lambda: stress.make_app(1024, capacity=1024)),
+                 ("fixed_point", fixed_point.make_app)]:
+    print(f"{name:12s}:", probe_program_variants(mk(), trials=60, warmup_frames=8).summary())
+PYEOF
+
 echo "== examples on device (quick) =="
 python examples/box_game_synctest.py --frames 120 --check-distance 3
 python examples/particles_stress.py --rate 100 --synctest --frames 120 --check-distance 3
