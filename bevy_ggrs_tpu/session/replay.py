@@ -26,15 +26,22 @@ from .requests import AdvanceRequest
 
 class InputRecorder:
     """Captures the last fully-confirmed inputs per frame via the runner's on_advance hook."""
-    def __init__(self, num_players: int, input_shape=(), input_dtype=np.uint8):
+    def __init__(self, num_players: int, input_shape=(), input_dtype=np.uint8,
+                 canonical_depth=None, canonical_branches=None):
         self.num_players = num_players
         self.input_shape = tuple(input_shape)
         self.input_dtype = np.dtype(input_dtype)
+        # program config: replays of variant-unstable float sims are only
+        # bit-faithful under the same canonical program (docs/determinism.md)
+        self.canonical_depth = canonical_depth
+        self.canonical_branches = canonical_branches
         self.frames: Dict[int, np.ndarray] = {}
 
     @classmethod
     def for_app(cls, app) -> "InputRecorder":
-        return cls(app.num_players, app.input_shape, app.input_dtype)
+        """Recorder matching the app's input spec and canonical config."""
+        return cls(app.num_players, app.input_shape, app.input_dtype,
+                   app.canonical_depth, app.canonical_branches)
 
     def on_advance(self, frame: int, inputs: np.ndarray, status: np.ndarray) -> None:
         """Runner hook: called for every executed AdvanceFrame request."""
@@ -58,16 +65,22 @@ class InputRecorder:
             num_players=self.num_players,
             input_shape=np.array(self.input_shape, np.int64),
             input_dtype=str(self.input_dtype),
+            canonical_depth=self.canonical_depth or -1,
+            canonical_branches=self.canonical_branches or -1,
         )
 
     @classmethod
     def load(cls, path: str) -> "InputRecorder":
         """Load a recording written by save()."""
         z = np.load(path, allow_pickle=False)
+        cd = int(z["canonical_depth"]) if "canonical_depth" in z else -1
+        cb = int(z["canonical_branches"]) if "canonical_branches" in z else -1
         rec = cls(
             int(z["num_players"]),
             tuple(int(x) for x in z["input_shape"]),
             np.dtype(str(z["input_dtype"])),
+            canonical_depth=None if cd < 0 else cd,
+            canonical_branches=None if cb < 0 else cb,
         )
         for f, row in zip(z["frames"], z["inputs"]):
             rec.frames[int(f)] = row.astype(rec.input_dtype)

@@ -48,6 +48,9 @@ def cmd_checksums(args):
 
     rec = load(args.recording)
     app = getattr(models, args.model).make_app(num_players=rec.num_players)
+    # bit-faithful replay requires the recorded canonical program config
+    app.canonical_depth = rec.canonical_depth
+    app.canonical_branches = rec.canonical_branches
     runner = GgrsRunner(app, ReplaySession(rec))
     while not runner.session.finished:
         runner.tick()
