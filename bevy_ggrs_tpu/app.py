@@ -169,6 +169,8 @@ class App:
 
     @cached_property
     def advance_fn(self):
+        """jit single-frame advance -> (state, checksum); routes through the
+        canonical program when bit-determinism mode is configured."""
         if self.canonical_depth is not None:
             # route single advances through the SAME canonical program
             resim = self.resim_fn
@@ -199,6 +201,8 @@ class App:
 
     @cached_property
     def resim_fn(self):
+        """jit k-frame resim -> (final, stacked, checksums); canonical modes
+        route through the single fixed-shape program."""
         if self.canonical_branches is not None:
             return self._branched_resim_wrapper()
         if self.canonical_depth is not None:

@@ -36,6 +36,7 @@ class VariantProbeReport:
         return self.mismatching_trials == 0
 
     def summary(self) -> str:
+        """One-line human-readable verdict."""
         if self.stable:
             return (
                 f"stable: {self.trials} random trials bit-identical across "
