@@ -44,13 +44,17 @@ def main():
     ap.add_argument("--max-prediction", type=int, default=12)
     ap.add_argument("--fps", type=int, default=60)
     ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--canonical", action="store_true",
+                    help="bit-determinism program (docs/determinism.md): "
+                         "required when peers' float rounding must match "
+                         "exactly; costs max_prediction+2 frames of compute "
+                         "per dispatch (cheap on TPU, heavy on CPU)")
     args = ap.parse_args()
 
-    # canonical_depth: networked float play defaults to the bit-determinism
-    # program (docs/determinism.md) — rollback segmentation differences
-    # between peers must not change rounding
-    app = box_game.make_app(num_players=len(args.players), fps=args.fps,
-                            canonical_depth=args.max_prediction + 2)
+    app = box_game.make_app(
+        num_players=len(args.players), fps=args.fps,
+        canonical_depth=(args.max_prediction + 2) if args.canonical else None,
+    )
     sock = UdpNonBlockingSocket(args.local_port)
     b = (
         SessionBuilder.for_app(app)

@@ -39,12 +39,16 @@ def main():
     ap.add_argument("--frames", type=int, default=1200)
     ap.add_argument("--speculate", action="store_true",
                     help="hedge predicted remote inputs (branch cache)")
+    ap.add_argument("--canonical", action="store_true",
+                    help="bit-determinism program (docs/determinism.md)")
     args = ap.parse_args()
 
-    # networked play: bit-determinism program (docs/determinism.md); with
+    # --canonical: bit-determinism program (docs/determinism.md); with
     # --speculate the program gains fixed hedge lanes (canonical_branches)
-    app = pong.make_app(canonical_depth=10)
+    app = pong.make_app(canonical_depth=10 if args.canonical else None)
     if args.speculate:
+        if not args.canonical:
+            app.canonical_depth = 10
         app.canonical_branches = 4  # lane 0 real + 3 hedge candidates
     b = SessionBuilder.for_app(app).with_input_delay(1)
 
