@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""End-to-end DRIVER throughput: full ticks/sec (session + protocol + fused
+dispatch) for the synctest oracle and a 2-peer channel-network P2P game.
+Complements bench.py (raw resim throughput).  One JSON line per config."""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np
+
+
+def bench_synctest(n_entities=2000, ticks=150, check_distance=7):
+    from bevy_ggrs_tpu import GgrsRunner, SyncTestSession
+    from bevy_ggrs_tpu.models import stress
+
+    app = stress.make_app(n_entities, capacity=n_entities)
+    session = SyncTestSession(num_players=2, input_shape=(),
+                              input_dtype=np.uint8,
+                              check_distance=check_distance)
+    runner = GgrsRunner(app, session)
+    for _ in range(5):
+        runner.tick()  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        runner.tick()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"driver_synctest_ticks_per_sec_{n_entities}ent_cd{check_distance}",
+        "value": round(ticks / dt, 1), "unit": "ticks/s",
+    }))
+
+
+def bench_p2p_channel(n_entities=2000, ticks=300):
+    from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
+    from bevy_ggrs_tpu.models import stress
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    net = ChannelNetwork(latency_hops=2)
+    socks = [net.endpoint("a"), net.endpoint("b")]
+    runners = []
+    for i in range(2):
+        app = stress.make_app(n_entities, capacity=n_entities)
+        b = (SessionBuilder.for_app(app).with_input_delay(1)
+             .with_disconnect_timeout(60.0).with_disconnect_notify_delay(30.0)
+             .add_player(PlayerType.LOCAL, i)
+             .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a"))
+        runners.append(GgrsRunner(app, b.start_p2p_session(socks[i])))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING for r in runners):
+            break
+        time.sleep(0.001)
+    for _ in range(10):  # warmup
+        net.deliver()
+        for r in runners:
+            r.update(1 / 60)
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        net.deliver()
+        for r in runners:
+            r.update(1 / 60)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"driver_p2p_pair_ticks_per_sec_{n_entities}ent",
+        "value": round(ticks / dt, 1), "unit": "ticks/s",
+        "rollbacks": runners[0].stats()["rollbacks"],
+    }))
+
+
+if __name__ == "__main__":
+    import jax
+
+    print(json.dumps({"metric": "platform",
+                      "value": jax.devices()[0].platform, "unit": ""}))
+    bench_synctest()
+    bench_p2p_channel()

@@ -143,6 +143,8 @@ class GgrsRunner:
 
         names = list(names) if names is not None else list(self.app.reg.components)
         arrays = {n: self.world.comps[n] for n in names}
+        for n in names:
+            arrays[f"__has_{n}__"] = self.world.has[n]
         arrays["__active__"] = active_mask(self.world)
         out = jax.device_get(arrays)
         return {k: np.asarray(v) for k, v in out.items()}

@@ -16,6 +16,9 @@ python bench.py
 echo "== criterion equivalents =="
 python benches/criterion_equiv.py --iters 100
 
+echo "== end-to-end driver throughput =="
+python benches/driver_bench.py
+
 echo "== cross-backend checksum parity =="
 python scripts/parity_check.py
 
