@@ -6,6 +6,7 @@ cd "$(dirname "$0")/.."
 echo "== native build + tests =="
 make -C native
 make -C native test
+make -C native asan
 
 echo "== docs coverage =="
 python scripts/docs_check.py
