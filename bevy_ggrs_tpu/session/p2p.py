@@ -213,8 +213,6 @@ class P2PSession:
         return adv
 
     def _make_on_input(self, addr):
-        handles = sorted(self.remote_handle_addr)
-
         def cb(frame: int, raw: bytes) -> None:
             hs = self._handle_of_addr[addr]
             for i, h in enumerate(hs):
