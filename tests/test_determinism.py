@@ -145,3 +145,17 @@ def test_variant_probe_flags_unstable_and_passes_stable():
     app2.set_setup(setup)
     rep2 = probe_program_variants(app2, trials=20, warmup_frames=4)
     assert rep2.stable, rep2.summary()
+
+
+def test_fixed_point_golden_checksum():
+    """Cross-round determinism anchor: the integer model's checksum for a
+    pinned input sequence is an exact constant.  If a change to the hash,
+    world layout, frame semantics, or model breaks this, it breaks replay
+    and cross-peer compatibility with earlier builds — change it knowingly
+    (and note it in NOTES.md) or not at all."""
+    app = fixed_point.make_app()
+    rng = np.random.default_rng(7)
+    inputs = rng.integers(0, 16, (12, 2)).astype(np.uint8)
+    status = np.zeros((12, 2), np.int8)
+    _, _, checks = app.resim_fn(app.init_state(), inputs, status, 0)
+    assert checksum_to_int(np.asarray(checks)[-1]) == 0x5898EBD39DB5B0DC
