@@ -48,12 +48,14 @@ class GgrsRunner:
         initial_state=None,
         speculation: Optional[SpeculationConfig] = None,
         on_advance: Optional[Callable] = None,
+        on_confirmed: Optional[Callable[[int], None]] = None,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
         self.on_event = on_event
         self.on_mismatch = on_mismatch
         self.on_advance = on_advance  # (frame, inputs, status) per AdvanceFrame
+        self.on_confirmed = on_confirmed  # (frame) when confirmed advances
         self.world = initial_state if initial_state is not None else app.init_state()
         self._world_checksum = app.checksum_fn(self.world)
         self.ring: SnapshotRing = SnapshotRing(depth=8)
@@ -102,7 +104,19 @@ class GgrsRunner:
         self.confirmed = NULL_FRAME
         self.ring.clear()
         if session is not None:
-            self.ring.set_depth(session.max_prediction() + 2)
+            # despawn-retirement safety invariant (ops/resim.py docstring):
+            # slots hard-freed at frame-retention must never sit inside the
+            # rollback window, or a rollback could restore a snapshot whose
+            # despawn the corrected inputs would have cancelled
+            mp = session.max_prediction()
+            if self.app.retention < mp:
+                raise ValueError(
+                    f"App(retention={self.app.retention}) < session "
+                    f"max_prediction ({mp}): raise retention to at least the "
+                    "prediction window (see ops/resim.py despawn-retirement "
+                    "invariant)"
+                )
+            self.ring.set_depth(mp + 2)
             # sessions may start at a nonzero frame (wraparound tests, resumed
             # sessions); mirror it so ctx.frame/time agree from tick one
             cur = getattr(session, "current_frame", 0)
@@ -259,6 +273,8 @@ class GgrsRunner:
             self.ring.set_depth(s.max_prediction() + 2)
             self.confirmed = s.confirmed_frame()
             self.ring.confirm(self.confirmed)  # discard_old_snapshots
+            if self.on_confirmed is not None and self.confirmed != NULL_FRAME:
+                self.on_confirmed(self.confirmed)
             i = 0
             n = len(requests)
             while i < n:

@@ -88,6 +88,7 @@ def load_library() -> C.CDLL:
     lib.ggrs_p2p_local_handles.argtypes = [P, C.POINTER(C.c_int32), C.c_int]
     lib.ggrs_p2p_next_event.argtypes = [P, C.POINTER(C.c_int32),
                                         C.POINTER(C.c_int32), C.POINTER(C.c_uint64),
+                                        C.POINTER(C.c_uint64),
                                         C.c_char_p, C.c_int]
     lib.ggrs_p2p_push_checksum.argtypes = [P, C.c_int32, C.c_uint64]
     lib.ggrs_p2p_stats.argtypes = [P, C.c_int, C.POINTER(C.c_double),
@@ -118,6 +119,7 @@ def _bind_spectator(lib: C.CDLL) -> None:
                                            C.POINTER(C.c_int), C.POINTER(C.c_int)]
     lib.ggrs_spectator_next_event.argtypes = [P, C.POINTER(C.c_int32),
                                               C.POINTER(C.c_int32),
+                                              C.POINTER(C.c_uint64),
                                               C.POINTER(C.c_uint64),
                                               C.c_char_p, C.c_int]
 
@@ -329,9 +331,10 @@ class NativeP2PSession:
         kind = C.c_int32(0)
         a = C.c_int32(0)
         b = C.c_uint64(0)
+        b2 = C.c_uint64(0)
         addr = C.create_string_buffer(64)
         while self._lib.ggrs_p2p_next_event(
-            self._s, C.byref(kind), C.byref(a), C.byref(b), addr, 64
+            self._s, C.byref(kind), C.byref(a), C.byref(b), C.byref(b2), addr, 64
         ):
             s = addr.value.decode()
             k = kind.value
@@ -346,16 +349,12 @@ class NativeP2PSession:
             elif k == _EV_RES:
                 self.events_buf.append(NetworkResumed(s))
             elif k == _EV_DESYNC:
-                local = self._lookup_local_checksum(a.value)
                 self.events_buf.append(
                     DesyncDetected(
-                        frame=a.value, local_checksum=local,
+                        frame=a.value, local_checksum=int(b2.value),
                         remote_checksum=int(b.value), addr=s,
                     )
                 )
-
-    def _lookup_local_checksum(self, frame: int):
-        return None  # native core keeps it; exposed only for display parity
 
 
 class NativeSpectatorSession:
@@ -475,9 +474,10 @@ class NativeSpectatorSession:
         kind = C.c_int32(0)
         a = C.c_int32(0)
         b = C.c_uint64(0)
+        b2 = C.c_uint64(0)
         addr = C.create_string_buffer(64)
         while self._lib.ggrs_spectator_next_event(
-            self._s, C.byref(kind), C.byref(a), C.byref(b), addr, 64
+            self._s, C.byref(kind), C.byref(a), C.byref(b), C.byref(b2), addr, 64
         ):
             s = addr.value.decode()
             k = kind.value

@@ -43,6 +43,24 @@ from .protocol import PeerEndpoint
 from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 
 
+def _min_ack(endpoints):
+    """Oldest last-acked frame across CONNECTED endpoints.
+
+    Returns ``None`` when no connected endpoint remains (pending history can
+    be dropped entirely), ``NULL_FRAME`` when some connected endpoint has not
+    acked anything yet (nothing may be trimmed — a still-syncing peer or
+    spectator must be able to receive the stream from its base), else the
+    wraparound-safe minimum ack."""
+    acked = None
+    for ep in endpoints:
+        if ep.disconnected:
+            continue
+        if ep.last_acked == NULL_FRAME:
+            return NULL_FRAME
+        acked = ep.last_acked if acked is None else frame_min(acked, ep.last_acked)
+    return acked
+
+
 class P2PSession:
     """Python-core P2P session (see module docstring for semantics)."""
     def __init__(
@@ -358,13 +376,13 @@ class P2PSession:
         horizon = frame_add(self._confirmed, -self._max_prediction - 2)
         for q in self.queues.values():
             q.gc(horizon)
-        acked = min(
-            (ep.last_acked for ep in self.endpoints.values()), default=NULL_FRAME
-        )
-        self._local_sent = [
-            p for p in self._local_sent
-            if acked == NULL_FRAME or frame_gt(p[0], acked)
-        ]
+        acked = _min_ack(self.endpoints.values())
+        if acked is None:
+            self._local_sent = []  # no connected remotes: nothing to deliver
+        elif acked != NULL_FRAME:
+            self._local_sent = [
+                p for p in self._local_sent if frame_gt(p[0], acked)
+            ]
         for fr in [f for f in self._local_checksums if frame_lt(f, horizon)]:
             del self._local_checksums[fr]
         for key in [k for k in self._remote_checksums if frame_lt(k[1], horizon)]:
@@ -385,11 +403,10 @@ class P2PSession:
                 rows.append(np.ascontiguousarray(v).tobytes())
             self._spectator_sent.append((f, b"".join(rows)))
             self._next_spectator_frame = frame_add(self._next_spectator_frame, 1)
-        acked = min(
-            (ep.last_acked for ep in self.spectator_endpoints.values()),
-            default=NULL_FRAME,
-        )
-        if acked != NULL_FRAME:
+        acked = _min_ack(self.spectator_endpoints.values())
+        if acked is None:
+            self._spectator_sent = []  # every spectator disconnected
+        elif acked != NULL_FRAME:
             self._spectator_sent = [
                 p for p in self._spectator_sent if frame_gt(p[0], acked)
             ]

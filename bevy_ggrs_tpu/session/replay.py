@@ -7,25 +7,27 @@ compare checksums frame by frame.  (The reference has no replay facility;
 this is a natural extension of its determinism model.)
 
 ``InputRecorder`` plugs into :class:`~bevy_ggrs_tpu.runner.GgrsRunner` via
-the ``on_advance`` hook and keeps the LAST fully-confirmed inputs seen for
-each frame (a frame advanced on predictions is later re-advanced with
-confirmed inputs during the rollback — the final all-confirmed advance is
-the truth).  ``ReplaySession`` feeds a recording back through the normal
-driver as an advance-only session."""
+the ``on_advance`` + ``on_confirmed`` hooks.  Every advance is recorded and
+a rollback's corrective re-advance overwrites the mispredicted one; a frame
+becomes *final* once the session's confirmed frame passes it (a correctly-
+predicted frame is never re-advanced, so waiting for an all-confirmed
+advance would leave permanent gaps in P2P recordings) or when its advance
+already carried all-CONFIRMED inputs.  ``ReplaySession`` feeds the final
+frames back through the normal driver as an advance-only session."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
-from ..utils.frames import frame_add
+from ..utils.frames import NULL_FRAME, frame_add, frame_le
 from .events import InputStatus, PredictionThresholdError
 from .requests import AdvanceRequest
 
 
 class InputRecorder:
-    """Captures the last fully-confirmed inputs per frame via the runner's on_advance hook."""
+    """Captures the confirmed input stream via the runner's on_advance/on_confirmed hooks."""
     def __init__(self, num_players: int, input_shape=(), input_dtype=np.uint8,
                  canonical_depth=None, canonical_branches=None):
         self.num_players = num_players
@@ -36,6 +38,8 @@ class InputRecorder:
         self.canonical_depth = canonical_depth
         self.canonical_branches = canonical_branches
         self.frames: Dict[int, np.ndarray] = {}
+        self._all_confirmed: Set[int] = set()
+        self._watermark: int = NULL_FRAME  # session confirmed frame
 
     @classmethod
     def for_app(cls, app) -> "InputRecorder":
@@ -44,22 +48,48 @@ class InputRecorder:
                    app.canonical_depth, app.canonical_branches)
 
     def on_advance(self, frame: int, inputs: np.ndarray, status: np.ndarray) -> None:
-        """Runner hook: called for every executed AdvanceFrame request."""
+        """Runner hook: called for every executed AdvanceFrame request.
+
+        Records unconditionally — a later corrective re-advance (rollback)
+        overwrites, so by the time a frame is final the stored value is the
+        confirmed truth."""
+        self.frames[frame] = np.array(inputs, self.input_dtype)
         if np.all(status == InputStatus.CONFIRMED):
-            self.frames[frame] = np.array(inputs, self.input_dtype)
+            self._all_confirmed.add(frame)
+
+    def on_confirmed(self, frame: int) -> None:
+        """Runner hook: the session's confirmed frame advanced to ``frame``."""
+        if self._watermark == NULL_FRAME or frame_le(self._watermark, frame):
+            self._watermark = frame
+
+    def _is_final(self, frame: int) -> bool:
+        # recorded key = post-advance frame; its transition consumed the
+        # inputs AT key-1, which are final once confirmed >= key-1, i.e.
+        # key <= confirmed+1.  Rollbacks only ever target frames beyond the
+        # confirmed frame, so these keys can never be re-advanced again.
+        if frame in self._all_confirmed:
+            return True
+        return self._watermark != NULL_FRAME and frame_le(
+            frame, frame_add(self._watermark, 1)
+        )
+
+    def final_frames(self) -> Dict[int, np.ndarray]:
+        """The confirmed (replay-safe) portion of the recording."""
+        return {f: v for f, v in self.frames.items() if self._is_final(f)}
 
     def __len__(self) -> int:
-        return len(self.frames)
+        return len(self.final_frames())
 
     # -- persistence --------------------------------------------------------
 
     def save(self, path: str) -> None:
-        """Write the recording to a compressed .npz file."""
-        keys = sorted(self.frames)
+        """Write the final (confirmed) frames to a compressed .npz file."""
+        final = self.final_frames()
+        keys = sorted(final)
         np.savez_compressed(
             path,
             frames=np.array(keys, np.int64),
-            inputs=np.stack([self.frames[k] for k in keys])
+            inputs=np.stack([final[k] for k in keys])
             if keys
             else np.zeros((0, self.num_players, *self.input_shape), self.input_dtype),
             num_players=self.num_players,
@@ -84,6 +114,7 @@ class InputRecorder:
         )
         for f, row in zip(z["frames"], z["inputs"]):
             rec.frames[int(f)] = row.astype(rec.input_dtype)
+            rec._all_confirmed.add(int(f))  # saved frames are final
         return rec
 
 
@@ -94,7 +125,8 @@ class ReplaySession:
 
     def __init__(self, recording: InputRecorder, start_frame: Optional[int] = None):
         self.rec = recording
-        frames = sorted(recording.frames)
+        self._frames = recording.final_frames()
+        frames = sorted(self._frames)
         self.current_frame = start_frame if start_frame is not None else (
             frames[0] if frames else 0
         )
@@ -121,9 +153,9 @@ class ReplaySession:
 
     def advance_frame(self) -> List:
         """Emit the next recorded frame as a confirmed Advance request."""
-        if self.current_frame not in self.rec.frames:
+        if self.current_frame not in self._frames:
             raise PredictionThresholdError()  # gap or end of recording
-        inputs = self.rec.frames[self.current_frame]
+        inputs = self._frames[self.current_frame]
         self.current_frame = frame_add(self.current_frame, 1)
         status = np.full((self.rec.num_players,), InputStatus.CONFIRMED, np.int8)
         return [AdvanceRequest(inputs, status)]

@@ -260,23 +260,29 @@ def spawn(
 
     Assigns the next monotonic rollback id — the on-add hook + RollbackOrdered
     push of the reference (/root/reference/src/snapshot/rollback.rs:45-59).
-    If the world is full the ``overflow`` flag is set (checked host-side)."""
+    If the world is full nothing is written (live entities are untouched), the
+    ``overflow`` flag is set (checked host-side), and the returned slot is -1."""
     comps = comps or {}
     free = ~w.alive
     any_free = jnp.any(free)
-    slot = jnp.argmax(free).astype(jnp.int32)  # first free slot
+    slot = jnp.argmax(free).astype(jnp.int32)  # first free slot (0 when full)
+
+    def put(arr, value):
+        # masked write: a full world must leave slot 0's live state intact
+        return arr.at[slot].set(jnp.where(any_free, value, arr[slot]))
+
     new_comps = dict(w.comps)
     new_has = dict(w.has)
     for name, spec in reg.components.items():
         if name in comps:
             row = jnp.asarray(comps[name], spec.dtype)
-            new_comps[name] = new_comps[name].at[slot].set(row)
-            new_has[name] = new_has[name].at[slot].set(True)
+            new_comps[name] = put(new_comps[name], row)
+            new_has[name] = put(new_has[name], True)
         elif spec.required:
-            new_comps[name] = new_comps[name].at[slot].set(spec.default)
-            new_has[name] = new_has[name].at[slot].set(True)
+            new_comps[name] = put(new_comps[name], spec.default)
+            new_has[name] = put(new_has[name], True)
         else:
-            new_has[name] = new_has[name].at[slot].set(False)
+            new_has[name] = put(new_has[name], False)
     unknown = set(comps) - set(reg.components)
     if unknown:
         raise KeyError(f"spawn with unregistered components: {sorted(unknown)}")
@@ -285,13 +291,13 @@ def spawn(
             w,
             comps=new_comps,
             has=new_has,
-            alive=w.alive.at[slot].set(True),
-            rollback_id=w.rollback_id.at[slot].set(w.next_id),
-            despawn_pending=w.despawn_pending.at[slot].set(False),
-            next_id=w.next_id + 1,
+            alive=put(w.alive, True),
+            rollback_id=put(w.rollback_id, w.next_id),
+            despawn_pending=put(w.despawn_pending, False),
+            next_id=w.next_id + any_free.astype(w.next_id.dtype),
             overflow=w.overflow | ~any_free,
         ),
-        slot,
+        jnp.where(any_free, slot, jnp.int32(-1)),
     )
 
 

@@ -153,6 +153,7 @@ struct Event {
   int32_t a = 0;
   uint64_t b = 0;
   Addr addr;
+  uint64_t b2 = 0; /* DESYNC: local checksum (b = remote) */
 };
 
 /* ---- per-peer endpoint (matches session/protocol.py PeerEndpoint) ------ */
@@ -626,7 +627,7 @@ void ggrs_p2p_poll(GgrsP2P *s) {
       if (it == s->local_checksums.end())
         s->remote_checksums[f].emplace_back(addr, remote_cs);
       else if (it->second != remote_cs)
-        s->events.push_back({GGRS_EV_DESYNC, f, remote_cs, addr});
+        s->events.push_back({GGRS_EV_DESYNC, f, remote_cs, addr, it->second});
     }
     ep->checksum_inbox.clear();
     if (ep->state == GGRS_RUNNING && !ep->disconnected)
@@ -751,17 +752,27 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
   /* gc */
   Frame horizon = s->confirmed - s->max_prediction - 2;
   for (auto &q : s->queues) q.gc(horizon);
+  /* trim pending input history to the oldest ack across CONNECTED peers.
+   * A connected peer that has not acked anything yet (last_acked ==
+   * NULL_FRAME — still syncing, or all its acks were lost) blocks trimming
+   * entirely: dropping frames it never saw would stall it forever.  With no
+   * connected peers left the history has no consumer and is dropped.
+   * (Matches session/p2p.py _min_ack.) */
   Frame acked = NULL_FRAME;
-  bool first = true;
+  bool keep_all = false, any_connected = false;
   for (auto &[a, ep] : s->endpoints) {
-    if (first || (ep->last_acked != NULL_FRAME &&
-                  (acked == NULL_FRAME || frame_lt(ep->last_acked, acked))))
+    if (ep->disconnected) continue;
+    any_connected = true;
+    if (ep->last_acked == NULL_FRAME) { keep_all = true; break; }
+    if (acked == NULL_FRAME || frame_lt(ep->last_acked, acked))
       acked = ep->last_acked;
-    first = false;
   }
-  while (!s->local_sent.empty() && acked != NULL_FRAME &&
-         frame_le(s->local_sent.front().first, acked))
-    s->local_sent.pop_front();
+  if (!any_connected)
+    s->local_sent.clear();
+  else if (!keep_all)
+    while (!s->local_sent.empty() && acked != NULL_FRAME &&
+           frame_le(s->local_sent.front().first, acked))
+      s->local_sent.pop_front();
   for (auto it = s->local_checksums.begin(); it != s->local_checksums.end();)
     it = frame_lt(it->first, horizon) ? s->local_checksums.erase(it) : std::next(it);
   for (auto it = s->remote_checksums.begin(); it != s->remote_checksums.end();)
@@ -786,21 +797,31 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
       s->spectator_sent.emplace_back(f, std::move(row));
       s->next_spectator_frame = f + 1;
     }
+    /* same keep-all-until-every-connected-spectator-acks rule as the peer
+     * history above: a late-syncing spectator must still be able to pull the
+     * stream from its base */
     Frame acked = NULL_FRAME;
-    bool first_sp = true;
+    bool keep_all = false, any_connected = false;
     for (auto &[a2, ep] : s->spectator_endpoints) {
-      if (first_sp || (acked != NULL_FRAME && ep->last_acked != NULL_FRAME &&
-                       frame_lt(ep->last_acked, acked)))
+      if (ep->disconnected) continue;
+      any_connected = true;
+      if (ep->last_acked == NULL_FRAME) { keep_all = true; break; }
+      if (acked == NULL_FRAME || frame_lt(ep->last_acked, acked))
         acked = ep->last_acked;
-      first_sp = false;
     }
-    while (!s->spectator_sent.empty() && acked != NULL_FRAME &&
-           frame_le(s->spectator_sent.front().first, acked))
-      s->spectator_sent.pop_front();
-    /* hard cap: a spectator >8 chunks (~8.5 s at 60fps) behind starts
-     * losing the oldest frames (it should have been catching up) */
-    while ((int)s->spectator_sent.size() > 8 * MAX_INPUTS_PER_PACKET)
-      s->spectator_sent.pop_front();
+    if (!any_connected)
+      s->spectator_sent.clear();
+    else if (!keep_all) {
+      while (!s->spectator_sent.empty() && acked != NULL_FRAME &&
+             frame_le(s->spectator_sent.front().first, acked))
+        s->spectator_sent.pop_front();
+      /* hard cap: an ACKING spectator >8 chunks (~8.5 s at 60fps) behind
+       * starts losing the oldest frames (it should have been catching up);
+       * never applied while one is still syncing (disconnect timeout bounds
+       * that state, so memory stays bounded either way) */
+      while ((int)s->spectator_sent.size() > 8 * MAX_INPUTS_PER_PACKET)
+        s->spectator_sent.pop_front();
+    }
   }
   *n_req_words = rw;
   *n_input_bytes = ib;
@@ -830,13 +851,14 @@ int ggrs_p2p_local_handles(GgrsP2P *s, int32_t *out, int cap) {
 }
 
 int ggrs_p2p_next_event(GgrsP2P *s, int32_t *kind, int32_t *a, uint64_t *b,
-                        char *addrbuf, int addrcap) {
+                        uint64_t *b2, char *addrbuf, int addrcap) {
   if (s->events.empty()) return 0;
   Event e = s->events.front();
   s->events.pop_front();
   *kind = e.kind;
   *a = e.a;
   *b = e.b;
+  *b2 = e.b2;
   std::string str = e.addr.str();
   snprintf(addrbuf, addrcap, "%s", str.c_str());
   return 1;
@@ -850,7 +872,7 @@ void ggrs_p2p_push_checksum(GgrsP2P *s, int32_t frame, uint64_t checksum) {
   if (pit != s->remote_checksums.end()) {
     for (auto &[addr, remote_cs] : pit->second)
       if (remote_cs != checksum)
-        s->events.push_back({GGRS_EV_DESYNC, frame, remote_cs, addr});
+        s->events.push_back({GGRS_EV_DESYNC, frame, remote_cs, addr, checksum});
     s->remote_checksums.erase(pit);
   }
   for (auto &[a, ep] : s->endpoints)
@@ -955,13 +977,15 @@ int ggrs_spectator_advance(GgrsSpectator *s, int32_t *req_buf, int req_cap,
 }
 
 int ggrs_spectator_next_event(GgrsSpectator *s, int32_t *kind, int32_t *a,
-                              uint64_t *b, char *addrbuf, int addrcap) {
+                              uint64_t *b, uint64_t *b2, char *addrbuf,
+                              int addrcap) {
   if (s->events.empty()) return 0;
   Event e = s->events.front();
   s->events.pop_front();
   *kind = e.kind;
   *a = e.a;
   *b = e.b;
+  *b2 = e.b2;
   std::string str = e.addr.str();
   snprintf(addrbuf, addrcap, "%s", str.c_str());
   return 1;

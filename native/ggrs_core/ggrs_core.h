@@ -79,11 +79,11 @@ int ggrs_p2p_max_prediction(GgrsP2P *s);
 int ggrs_p2p_num_players(GgrsP2P *s);
 int ggrs_p2p_local_handles(GgrsP2P *s, int32_t *out, int cap);
 
-/* events: returns 1 if an event was popped.  a/b meaning per kind:
- *  SYNCHRONIZING: a=count b=total; DESYNC: a=frame b=remote_checksum.
- *  addr written as "ip:port" into addrbuf (>=64 bytes). */
+/* events: returns 1 if an event was popped.  a/b/b2 meaning per kind:
+ *  SYNCHRONIZING: a=count b=total; DESYNC: a=frame b=remote_checksum
+ *  b2=local_checksum.  addr written as "ip:port" into addrbuf (>=64 bytes). */
 int ggrs_p2p_next_event(GgrsP2P *s, int32_t *kind, int32_t *a, uint64_t *b,
-                        char *addrbuf, int addrcap);
+                        uint64_t *b2, char *addrbuf, int addrcap);
 
 /* desync detection: the TPU side pushes confirmed-frame checksums here */
 void ggrs_p2p_push_checksum(GgrsP2P *s, int32_t frame, uint64_t checksum);
@@ -110,7 +110,8 @@ int ggrs_spectator_advance(GgrsSpectator *s, int32_t *req_buf, int req_cap,
                            uint8_t *input_buf, int input_cap,
                            int *n_req_words, int *n_input_bytes);
 int ggrs_spectator_next_event(GgrsSpectator *s, int32_t *kind, int32_t *a,
-                              uint64_t *b, char *addrbuf, int addrcap);
+                              uint64_t *b, uint64_t *b2, char *addrbuf,
+                              int addrcap);
 
 /* network stats for a remote handle */
 int ggrs_p2p_stats(GgrsP2P *s, int handle, double *ping_ms, int *send_queue,

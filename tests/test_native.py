@@ -166,6 +166,12 @@ def test_native_desync_detection():
         if desyncs:
             break
     assert desyncs
+    # the native event carries BOTH checksums (GgrsEvent::DesyncDetected
+    # surface, reference examples/stress_tests/particles.rs:299-314)
+    for e in desyncs:
+        assert e.local_checksum is not None
+        assert e.remote_checksum is not None
+        assert e.local_checksum != e.remote_checksum
 
 
 def test_native_stall_without_remote():
