@@ -109,14 +109,21 @@ class GgrsRunner:
             # rollback window, or a rollback could restore a snapshot whose
             # despawn the corrected inputs would have cancelled
             mp = session.max_prediction()
-            if self.app.retention < mp:
+            window = (
+                session.rollback_window()
+                if hasattr(session, "rollback_window")
+                else mp
+            )
+            if self.app.retention < window:
                 raise ValueError(
-                    f"App(retention={self.app.retention}) < session "
-                    f"max_prediction ({mp}): raise retention to at least the "
-                    "prediction window (see ops/resim.py despawn-retirement "
-                    "invariant)"
+                    f"App(retention={self.app.retention}) < session rollback "
+                    f"window ({window}): raise retention to at least the "
+                    "deepest rollback the session can request (see "
+                    "ops/resim.py despawn-retirement invariant)"
                 )
-            self.ring.set_depth(mp + 2)
+            # ring must hold a snapshot window frames back even if a session
+            # reports rollback_window > max_prediction
+            self.ring.set_depth(max(mp, window) + 2)
             # sessions may start at a nonzero frame (wraparound tests, resumed
             # sessions); mirror it so ctx.frame/time agree from tick one
             cur = getattr(session, "current_frame", 0)
@@ -270,7 +277,12 @@ class GgrsRunner:
         with span("HandleRequests"):
             s = self.session
             # mirror session -> driver counters (schedule_systems.rs:195-220)
-            self.ring.set_depth(s.max_prediction() + 2)
+            window = (
+                s.rollback_window()
+                if hasattr(s, "rollback_window")
+                else s.max_prediction()
+            )
+            self.ring.set_depth(max(s.max_prediction(), window) + 2)
             self.confirmed = s.confirmed_frame()
             self.ring.confirm(self.confirmed)  # discard_old_snapshots
             if self.on_confirmed is not None and self.confirmed != NULL_FRAME:

@@ -165,6 +165,11 @@ class P2PSession:
     def max_prediction(self) -> int:
         return self._max_prediction
 
+    def rollback_window(self) -> int:
+        """Deepest rollback this session can request (= the prediction
+        window: a misprediction older than it would have stalled first)."""
+        return self._max_prediction
+
     def confirmed_frame(self) -> int:
         return self._confirmed
 

@@ -57,6 +57,12 @@ class SyncTestSession:
     def max_prediction(self) -> int:
         return self._max_prediction
 
+    def rollback_window(self) -> int:
+        """Deepest rollback this session will ever request: every tick it
+        rolls back exactly ``check_distance`` frames
+        (schedule_systems.rs:85-118), regardless of ``max_prediction``."""
+        return self.check_distance
+
     def confirmed_frame(self) -> int:
         """current - check_distance once the warmup window has passed."""
         if self.check_distance == 0:

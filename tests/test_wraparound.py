@@ -60,6 +60,21 @@ def test_session_crosses_i32_boundary():
     assert len(runner.ring) <= runner.ring.depth
 
 
+def test_retention_guard_uses_session_rollback_window():
+    # retention must cover the session's ACTUAL rollback window: for SyncTest
+    # that is check_distance (not max_prediction, which defaults larger) —
+    # cd=3 with retention=6 is valid, cd=7 with retention=6 is not
+    import pytest
+
+    app = make_app(retention=6)
+    session = SyncTestSession(num_players=1, input_shape=(),
+                              input_dtype=np.uint8, check_distance=7)
+    with pytest.raises(ValueError, match="rollback window"):
+        GgrsRunner(app, session)
+    # P2P-shaped sessions validate against max_prediction
+    assert SyncTestSession(num_players=1, check_distance=3).rollback_window() == 3
+
+
 def test_despawn_across_boundary():
     # mark for despawn right before the wrap; retirement fires after it
     start = I32_MAX - 3
