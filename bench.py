@@ -13,14 +13,20 @@ snapshot copy.  This is a *stronger* baseline than the reference's
 per-entity-HashMap data path (SURVEY §3.6), implemented in
 bench_baselines.py.  vs_baseline = device_fps / numpy_cpu_fps.
 
-Also reported: speculative fan-out throughput (16 branches x 8 frames per
-dispatch — the jit(vmap(scan)) north-star shape).
+Rigor (criterion-equivalent, /root/reference/benches/bench.rs:47-95): every
+timed loop runs REPS times; the reported value is the MEDIAN and the spread
+(max-min)/median ships in the JSON so an unstable link shows up as a wide
+spread instead of a silently wrong point estimate.
+
+Speculation is reported as lane-0 USEFUL frames/s (one authoritative lane out
+of the 16-branch canonical dispatch); raw lane-frames/s (x16) is a secondary
+field.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
-import os
+import statistics
 import subprocess
 import sys
 import time
@@ -28,9 +34,15 @@ import time
 import numpy as np
 
 N_ENTITIES = 10_000
+N_ENTITIES_BIG = 100_000
 DEPTH = 8
 ITERS = 30
+REPS = 5
 SPEC_BRANCHES = 16
+
+# v5e-class HBM bandwidth for the %BW context figure (the workload is
+# bandwidth-bound: elementwise integrate + hash, no matmuls -> MXU ~idle)
+HBM_BYTES_PER_SEC = 819e9
 
 
 def _device_backend_usable(timeout_s: int = 90) -> bool:
@@ -46,39 +58,75 @@ def _device_backend_usable(timeout_s: int = 90) -> bool:
         return False
 
 
-def _bench_layout(app):
+def _median_spread(samples):
+    med = statistics.median(samples)
+    spread = (max(samples) - min(samples)) / med if med else 0.0
+    return med, spread
+
+
+def _bench_layout(app, n_players=2):
+    """Median-of-REPS resim frames/s for one app; returns (median, spread)."""
     import jax
-    import jax.numpy as jnp
     from bevy_ggrs_tpu.session.events import InputStatus
 
     world = app.init_state()
-    inputs = jax.device_put(jnp.zeros((DEPTH, 2), jnp.uint8))
-    status = jax.device_put(
-        jnp.full((DEPTH, 2), InputStatus.CONFIRMED, jnp.int8)
-    )
+    # host numpy inputs — what the driver actually passes per dispatch
+    inputs = np.zeros((DEPTH, n_players), np.uint8)
+    status = np.full((DEPTH, n_players), InputStatus.CONFIRMED, np.int8)
     fn = app.resim_fn
     final, stacked, checks = fn(world, inputs, status, 0)
     jax.block_until_ready((final, stacked, checks))
-    t0 = time.perf_counter()
-    w = world
-    for i in range(ITERS):
-        w, stacked, checks = fn(w, inputs, status, i * DEPTH)
-    jax.block_until_ready(w)
-    return DEPTH * ITERS / (time.perf_counter() - t0)
+    samples = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        w = world
+        for i in range(ITERS):
+            w, stacked, checks = fn(w, inputs, status, i * DEPTH)
+        jax.block_until_ready(w)
+        samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+    return _median_spread(samples)
+
+
+def _state_bytes(app):
+    """Total bytes of the registered component columns (one world copy)."""
+    import jax
+
+    world = app.init_state()
+    return sum(
+        a.size * a.dtype.itemsize for a in jax.tree.leaves(world.comps)
+    )
 
 
 def bench_device():
     import jax
     import jax.numpy as jnp
     from bevy_ggrs_tpu.models import stress, stress_soa
-    from bevy_ggrs_tpu.session.events import InputStatus
 
     # two layouts of the same workload: [N,3] matrices vs per-coordinate [N]
     # scalar columns (lane-friendly on TPU, docs/tpu_notes.md §2)
-    fps_mat = _bench_layout(stress.make_app(N_ENTITIES))
-    fps_soa = _bench_layout(stress_soa.make_app(N_ENTITIES))
-    fps = max(fps_mat, fps_soa)
-    layout = "scalar_columns" if fps_soa >= fps_mat else "vec3_columns"
+    fps_mat, spread_mat = _bench_layout(stress.make_app(N_ENTITIES))
+    fps_soa, spread_soa = _bench_layout(stress_soa.make_app(N_ENTITIES))
+    if fps_soa >= fps_mat:
+        fps, spread, layout = fps_soa, spread_soa, "scalar_columns"
+    else:
+        fps, spread, layout = fps_mat, spread_mat, "vec3_columns"
+
+    # game-scale secondary config
+    fps_big, spread_big = _bench_layout(
+        stress.make_app(N_ENTITIES_BIG, capacity=N_ENTITIES_BIG)
+    )
+
+    # bandwidth context: per resim frame the step reads+writes every column
+    # and the checksum re-reads them (~3 passes over the world).  Only
+    # meaningful against real TPU HBM — null on other platforms.
+    sb = _state_bytes(stress.make_app(N_ENTITIES))
+    bytes_per_frame = 3 * sb
+    platform = jax.devices()[0].platform
+    hbm_pct = (
+        100.0 * fps * bytes_per_frame / HBM_BYTES_PER_SEC
+        if platform == "tpu"
+        else None
+    )
 
     # speculative fan-out (BASELINE config 5: 4 players x 16 branches x
     # 8 frames over the 10k-entity world) via the CANONICAL branched program
@@ -93,21 +141,30 @@ def bench_device():
     nr = jax.device_put(jnp.full((SPEC_BRANCHES,), DEPTH, jnp.int32))
     out = spec(world, bi, bs, 0, nr)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for i in range(ITERS):
-        out = spec(world, bi, bs, i, nr)
-    jax.block_until_ready(out)
-    sdt = time.perf_counter() - t0
-    spec_fps = SPEC_BRANCHES * DEPTH * ITERS / sdt
+    spec_samples = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for i in range(ITERS):
+            out = spec(world, bi, bs, i, nr)
+        jax.block_until_ready(out)
+        spec_samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+    spec_fps, spec_spread = _median_spread(spec_samples)  # lane-0 useful
 
     # canonical bit-determinism mode (fixed k=16 program): the safe float
     # configuration's throughput, reported alongside the fast path
     capp = stress.make_app(N_ENTITIES)
     capp.canonical_depth = 16
-    fps_canon = _bench_layout(capp)
+    fps_canon, spread_canon = _bench_layout(capp)
 
-    platform = jax.devices()[0].platform
-    return fps, spec_fps, platform, layout, fps_mat, fps_soa, fps_canon
+    return {
+        "fps": fps, "spread": spread, "layout": layout,
+        "fps_mat": fps_mat, "fps_soa": fps_soa,
+        "fps_big": fps_big, "spread_big": spread_big,
+        "spec_fps": spec_fps, "spec_spread": spec_spread,
+        "fps_canon": fps_canon, "spread_canon": spread_canon,
+        "platform": platform, "hbm_pct": hbm_pct,
+        "bytes_per_frame": bytes_per_frame,
+    }
 
 
 def bench_numpy_baseline():
@@ -115,11 +172,13 @@ def bench_numpy_baseline():
 
     sim = NumpyStressSim(N_ENTITIES, seed=0)
     sim.resim(DEPTH)  # warmup
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        sim.resim(DEPTH)
-    dt = time.perf_counter() - t0
-    return DEPTH * ITERS / dt
+    samples = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            sim.resim(DEPTH)
+        samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+    return _median_spread(samples)
 
 
 def main():
@@ -129,20 +188,34 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    device_fps, spec_fps, platform, layout, fps_mat, fps_soa, fps_canon = bench_device()
-    cpu_fps = bench_numpy_baseline()
+    d = bench_device()
+    cpu_fps, cpu_spread = bench_numpy_baseline()
     result = {
         "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
-        "value": round(device_fps, 1),
+        "value": round(d["fps"], 1),
         "unit": "frames/s",
-        "vs_baseline": round(device_fps / cpu_fps, 2),
+        "vs_baseline": round(d["fps"] / cpu_fps, 2),
+        "spread": round(d["spread"], 3),
+        "reps": REPS,
         "baseline_numpy_cpu_fps": round(cpu_fps, 1),
-        "speculative_16branch_resim_fps": round(spec_fps, 1),
-        "best_layout": layout,
-        "vec3_layout_fps": round(fps_mat, 1),
-        "scalar_columns_fps": round(fps_soa, 1),
-        "canonical_mode_fps": round(fps_canon, 1),
-        "platform": platform,
+        "baseline_spread": round(cpu_spread, 3),
+        "resim_fps_100k_entities": round(d["fps_big"], 1),
+        "resim_fps_100k_spread": round(d["spread_big"], 3),
+        "speculative_lane0_useful_fps": round(d["spec_fps"], 1),
+        "speculative_lane_frames_per_sec": round(
+            d["spec_fps"] * SPEC_BRANCHES, 1
+        ),
+        "speculative_spread": round(d["spec_spread"], 3),
+        "best_layout": d["layout"],
+        "vec3_layout_fps": round(d["fps_mat"], 1),
+        "scalar_columns_fps": round(d["fps_soa"], 1),
+        "canonical_mode_fps": round(d["fps_canon"], 1),
+        "canonical_spread": round(d["spread_canon"], 3),
+        "approx_hbm_bw_util_pct": (
+            round(d["hbm_pct"], 2) if d["hbm_pct"] is not None else None
+        ),
+        "bytes_per_resim_frame": d["bytes_per_frame"],
+        "platform": d["platform"],
         "entities": N_ENTITIES,
         "rollback_depth": DEPTH,
         "tpu_fallback_to_cpu": fallback,

@@ -176,11 +176,11 @@ class App:
             resim = self.resim_fn
 
             def fn(state, inputs, status, frame, _unused=None):
-                import numpy as np
-
+                # keep device arrays on device (no np.asarray pull)
+                inputs = inputs if hasattr(inputs, "ndim") else np.asarray(inputs)
+                status = status if hasattr(status, "ndim") else np.asarray(status)
                 final, stacked, checks = resim(
-                    state, np.asarray(inputs)[None], np.asarray(status)[None],
-                    frame - 1,
+                    state, inputs[None], status[None], frame - 1
                 )
                 return final, checks[0]
 
@@ -221,29 +221,31 @@ class App:
 
         def wrapped(state, inputs_seq, status_seq, start_frame, _unused=None):
             import jax as _jax
+            import jax.numpy as _jnp
 
-            inputs_seq = np.asarray(inputs_seq)
-            status_seq = np.asarray(status_seq)
+            from .ops.resim import pad_repeat_last
+
             k = inputs_seq.shape[0]
             if k > K:
                 raise ValueError(
                     f"resim depth {k} exceeds canonical_depth {K}"
                 )
             pad = K - k
-            if pad:
-                inputs_seq = np.concatenate(
-                    [inputs_seq, np.repeat(inputs_seq[-1:], pad, axis=0)]
-                )
-                status_seq = np.concatenate(
-                    [status_seq, np.repeat(status_seq[-1:], pad, axis=0)]
-                )
-            ib = np.broadcast_to(inputs_seq[None], (B, *inputs_seq.shape)).copy()
-            sb = np.broadcast_to(status_seq[None], (B, *status_seq.shape)).copy()
+            inputs_seq = pad_repeat_last(inputs_seq, pad)
+            status_seq = pad_repeat_last(status_seq, pad)
+            xp = _jnp if isinstance(inputs_seq, _jax.Array) else np
+            ib = xp.broadcast_to(inputs_seq[None], (B, *inputs_seq.shape))
+            sp = _jnp if isinstance(status_seq, _jax.Array) else np
+            sb = sp.broadcast_to(status_seq[None], (B, *status_seq.shape))
             n_real = np.full((B,), k, np.int32)
             finals, stacked, checks = fn(state, ib, sb, start_frame, n_real)
-            lane0 = lambda t: _jax.tree.map(lambda a: a[0], t)
-            stacked0 = _jax.tree.map(lambda a: a[0, :k], stacked)
-            return lane0(finals), stacked0, checks[0, :k]
+            from .ops.resim import trim_frames
+            from .snapshot.lazy import tree_index
+
+            final0, (stacked0, checks0) = tree_index(
+                (finals, trim_frames((stacked, checks), k, axis=1)), 0
+            )
+            return final0, stacked0, checks0
 
         return wrapped
 

@@ -7,9 +7,19 @@ id mix, mask, block-sum — into one VMEM pass with an explicit grid, and
 (b) serve as the template for future pallas work (quantized snapshot packing).
 
 Grid: one program per entity block (``block x L`` lanes resident in VMEM);
-each program writes one partial uint32 sum per stream; the final (tiny)
-reduction happens in jnp.  Falls back to interpret mode off-TPU, so tests
-exercise it on CPU; ``use_pallas_checksum(app)`` swaps it into an App.
+the sequential TPU grid accumulates partial sums into a single (1, 2) output
+block.  Falls back to interpret mode off-TPU, so tests exercise it on CPU;
+``use_pallas_checksum(app)`` swaps it into an App.
+
+**Round-3 verdict (real v5e, via tunnel): compiles, bit-exact vs the jnp
+path at 10k/100k/1M entities — and does NOT beat XLA** (us/iter, median of
+3x50, includes ~2 ms dispatch latency): 10k: 2122 vs 2380 XLA (noise);
+100k: 2278 vs 1602; 1M: 14053 vs 2160.  XLA's fusion of the fold into the
+surrounding program is already bandwidth-optimal; the hand kernel's narrow
+(512, L<=3) blocks underuse the 8x128 VPU lanes.  It is therefore NOT the
+default — it stays as the validated pallas template for kernels XLA cannot
+fuse (e.g. quantized snapshot bit-packing), with cross-path parity pinned by
+tests/test_pallas_hash.py.
 """
 
 from __future__ import annotations
@@ -28,10 +38,17 @@ _BLOCK = 512
 
 def _hash_block_kernel(lanes_ref, ids_ref, mask_ref, out_ref, *, n_lanes, seed_hi, seed_lo):
     """One entity block: fold L lanes per row, mix the stable id, mask, and
-    emit the block's partial sum for both hash streams."""
+    accumulate the block's partial sum for both hash streams.
+
+    All refs are rank-2 (the TPU lowering requires >=2-D block shapes), and
+    the output is ONE (1, 2) block shared by every grid step — the TPU grid
+    is sequential, so accumulating into it is the canonical pallas reduction
+    (wrapping uint32 adds, matching the checksum's reduce semantics)."""
+    from jax.experimental import pallas as pl
+
     lanes = lanes_ref[...]  # [B, L] uint32
-    ids = ids_ref[...]  # [B] uint32
-    mask = mask_ref[...]  # [B] bool (as uint32 0/1)
+    ids = ids_ref[...][:, 0]  # [B, 1] -> [B] uint32
+    mask = mask_ref[...][:, 0]  # [B, 1] -> [B] uint32 0/1
     outs = []
     for seed in (seed_hi, seed_lo):
         h = jnp.full(lanes.shape[:1], seed, jnp.uint32)
@@ -40,9 +57,17 @@ def _hash_block_kernel(lanes_ref, ids_ref, mask_ref, out_ref, *, n_lanes, seed_h
         h = fmix32(h ^ jnp.uint32(n_lanes))
         h = fmix32(mix32(h, ids))
         h = jnp.where(mask != 0, h, jnp.uint32(0))
-        outs.append(jnp.sum(h, dtype=jnp.uint32))
-    out_ref[0] = outs[0]
-    out_ref[1] = outs[1]
+        # Mosaic has no unsigned reduction; int32 wrapping add is
+        # bit-identical (two's complement), so the accumulator stays int32
+        # in-kernel (scalar bitcast is unsupported) and the caller bitcasts
+        # the final (1, 2) block back to uint32
+        outs.append(jnp.sum(jax.lax.bitcast_convert_type(h, jnp.int32)))
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros((1, 2), jnp.int32)
+
+    out_ref[...] = out_ref[...] + jnp.stack(outs).reshape(1, 2)
 
 
 def component_part_pallas(
@@ -81,15 +106,14 @@ def component_part_pallas(
         grid=(blocks,),
         in_specs=[
             pl.BlockSpec((_BLOCK, l), lambda b: (b, 0)),
-            pl.BlockSpec((_BLOCK,), lambda b: (b,)),
-            pl.BlockSpec((_BLOCK,), lambda b: (b,)),
+            pl.BlockSpec((_BLOCK, 1), lambda b: (b, 0)),
+            pl.BlockSpec((_BLOCK, 1), lambda b: (b, 0)),
         ],
-        out_specs=pl.BlockSpec((2,), lambda b: (b,)),
-        out_shape=jax.ShapeDtypeStruct((blocks * 2,), jnp.uint32),
+        out_specs=pl.BlockSpec((1, 2), lambda b: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 2), jnp.int32),
         interpret=interpret,
-    )(lanes, ids, mask)
-    partials = partials.reshape(blocks, 2)
-    sums = jnp.sum(partials, axis=0, dtype=jnp.uint32)
+    )(lanes, ids[:, None], mask[:, None])
+    sums = jax.lax.bitcast_convert_type(partials, jnp.uint32)[0]
     return jnp.stack(
         [fmix32(sums[0] ^ jnp.uint32(tag_hi)), fmix32(sums[1] ^ jnp.uint32(tag_lo))]
     )

@@ -170,12 +170,47 @@ def resim_padded(
     return final, stacked, checks
 
 
+def pad_repeat_last(arr, pad: int):
+    """Extend the frame axis by repeating the last row ``pad`` times.
+
+    Device arrays are padded with device ops (an async dispatch); host arrays
+    with numpy.  Never forces a device->host transfer — calling ``np.asarray``
+    on a device array here was the canonical mode's TPU performance bug (one
+    flat-latency pull per dispatch; see docs/determinism.md)."""
+    if pad == 0:
+        return arr
+    if isinstance(arr, jax.Array):
+        return jnp.concatenate([arr, jnp.repeat(arr[-1:], pad, axis=0)])
+    import numpy as np
+
+    arr = np.asarray(arr)
+    return np.concatenate([arr, np.repeat(arr[-1:], pad, axis=0)])
+
+
+_trim_cache = {}
+
+
+def trim_frames(tree, k: int, axis: int = 0):
+    """``tree.map(a[:k])`` (or ``a[:, :k]`` for axis=1) as one jitted
+    dispatch (compiled once per (k, axis)) — eager per-leaf slicing costs one
+    op submission per leaf."""
+    fn = _trim_cache.get((k, axis))
+    if fn is None:
+        if axis == 0:
+            slicer = lambda a: a[:k]
+        else:
+            slicer = lambda a: a[:, :k]
+        fn = _trim_cache[(k, axis)] = jax.jit(
+            lambda t: jax.tree.map(slicer, t)
+        )
+    return fn(tree)
+
+
 def make_canonical_resim_fn(reg: Registry, step_fn: StepFn, fps: int,
                             seed: int = 0, retention: int = 16,
                             k_max: int = 16):
     """jit of :func:`resim_padded` — ONE compiled program for every advance,
     wrapped to the plain resim_fn signature (pads, dispatches, trims)."""
-    import numpy as np
 
     @jax.jit
     def fn(state, inputs_seq, status_seq, start_frame, n_real):
@@ -185,8 +220,6 @@ def make_canonical_resim_fn(reg: Registry, step_fn: StepFn, fps: int,
         )
 
     def wrapped(state, inputs_seq, status_seq, start_frame, _unused=None):
-        inputs_seq = np.asarray(inputs_seq)
-        status_seq = np.asarray(status_seq)
         k = inputs_seq.shape[0]
         if k > k_max:
             raise ValueError(
@@ -194,17 +227,12 @@ def make_canonical_resim_fn(reg: Registry, step_fn: StepFn, fps: int,
                 "App(canonical_depth=...) above every session window"
             )
         pad = k_max - k
-        if pad:
-            inputs_seq = np.concatenate(
-                [inputs_seq, np.repeat(inputs_seq[-1:], pad, axis=0)]
-            )
-            status_seq = np.concatenate(
-                [status_seq, np.repeat(status_seq[-1:], pad, axis=0)]
-            )
+        inputs_seq = pad_repeat_last(inputs_seq, pad)
+        status_seq = pad_repeat_last(status_seq, pad)
         final, stacked, checks = fn(state, inputs_seq, status_seq, start_frame, k)
         if pad:
-            stacked = jax.tree.map(lambda a: a[:k], stacked)
-            checks = checks[:k]
+            # one fused dispatch trims both (tuple pytree), not one per leaf
+            stacked, checks = trim_frames((stacked, checks), k)
         return final, stacked, checks
 
     return wrapped
@@ -285,5 +313,8 @@ def select_branch(tree, idx):
 
 
 def slice_frame(stacked_states, i):
-    """Extract the state after the (i+1)-th advance from stacked resim output."""
-    return jax.tree.map(lambda a: a[i], stacked_states)
+    """Extract the state after the (i+1)-th advance from stacked resim output
+    (one jitted dispatch — see snapshot/lazy.tree_index)."""
+    from ..snapshot.lazy import tree_index
+
+    return tree_index(stacked_states, i)

@@ -8,9 +8,10 @@ collectives (the scaling-book recipe: pick a mesh, annotate, let XLA place
 psum/all-gather on ICI).
 
 Correctness notes:
-- the checksum is an XOR reduction over the entity axis — exact under any
-  sharding (XOR is associative/commutative), so sharded and single-device
-  runs produce bit-identical checksums as long as the state bits match;
+- the checksum reduces over the entity axis with *wrapping uint32 addition*
+  (snapshot/checksum.py:12-19) — associative/commutative integer arithmetic,
+  exact under any sharding (a plain psum), so sharded and single-device runs
+  produce bit-identical checksums as long as the state bits match;
 - ``spawn``/``spawn_many`` use cumsum/argmax over the sharded axis, which XLA
   lowers to scan+collectives — deterministic regardless of layout.
 """

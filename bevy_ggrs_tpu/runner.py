@@ -29,6 +29,7 @@ from .session.events import (
 from .session.requests import AdvanceRequest, GgrsRequest, LoadRequest, SaveRequest
 from .session.synctest import SyncTestSession
 from .snapshot.checksum import checksum_to_int
+from .snapshot.lazy import BatchChecks, LazySlice, materialize, wrap_single_checksum
 from .snapshot.ring import SnapshotRing
 from .ops.resim import slice_frame
 from .ops.speculation import SpeculationCache, SpeculationConfig
@@ -57,7 +58,7 @@ class GgrsRunner:
         self.on_advance = on_advance  # (frame, inputs, status) per AdvanceFrame
         self.on_confirmed = on_confirmed  # (frame) when confirmed advances
         self.world = initial_state if initial_state is not None else app.init_state()
-        self._world_checksum = app.checksum_fn(self.world)
+        self._world_checksum = wrap_single_checksum(app.checksum_fn(self.world))
         self.ring: SnapshotRing = SnapshotRing(depth=8)
         self.frame = 0  # RollbackFrameCount
         self.confirmed = NULL_FRAME  # ConfirmedFrameCount
@@ -95,7 +96,12 @@ class GgrsRunner:
 
     def set_session(self, session) -> None:
         """Insert (or replace) the session; None resets driver state the way
-        removing the ``Session`` resource does in the reference."""
+        removing the ``Session`` resource does in the reference.
+
+        An outgoing session with deferred checksum comparison is flushed
+        first so no frame leaves the driver uncompared."""
+        if self.session is not None and self.session is not session:
+            self._flush_session_checks()
         self.session = session
         self.accumulator = 0.0
         self.run_slow = False
@@ -128,6 +134,27 @@ class GgrsRunner:
             # sessions); mirror it so ctx.frame/time agree from tick one
             cur = getattr(session, "current_frame", 0)
             self.frame = cur() if callable(cur) else cur
+
+    def _flush_session_checks(self) -> None:
+        """Force any deferred checksum comparisons on the current session,
+        routing a mismatch to ``on_mismatch`` like a ticking one would."""
+        s = self.session
+        if s is None or not hasattr(s, "check_now"):
+            return
+        try:
+            s.check_now()
+        except MismatchedChecksumError as e:
+            trace_log("SyncTest mismatch (flush): %s", e)
+            if self.on_mismatch is not None:
+                self.on_mismatch(e)
+            else:
+                raise
+
+    def finish(self) -> None:
+        """End-of-run hook: flush deferred checksum comparisons (SyncTest
+        with ``compare_interval`` > 1 would otherwise leave the final window
+        of frames uncompared — see docs/debugging-desyncs.md §1)."""
+        self._flush_session_checks()
 
     # -- fixed-timestep driver (schedule_systems.rs:19-83) ------------------
 
@@ -285,8 +312,6 @@ class GgrsRunner:
             self.ring.set_depth(max(s.max_prediction(), window) + 2)
             self.confirmed = s.confirmed_frame()
             self.ring.confirm(self.confirmed)  # discard_old_snapshots
-            if self.on_confirmed is not None and self.confirmed != NULL_FRAME:
-                self.on_confirmed(self.confirmed)
             i = 0
             n = len(requests)
             while i < n:
@@ -302,6 +327,12 @@ class GgrsRunner:
                         j += 1
                     self._run_batch(requests[i:j])
                     i = j
+            # fire AFTER the batch: a corrective Load/Advance in the same
+            # request list must land before observers treat the frame as
+            # final (a replay watermark reading final_frames() from this
+            # hook would otherwise persist the mispredicted inputs)
+            if self.on_confirmed is not None and self.confirmed != NULL_FRAME:
+                self.on_confirmed(self.confirmed)
 
     def _load(self, frame: int) -> None:
         """LoadGameState: restore the ring snapshot for ``frame``
@@ -309,7 +340,7 @@ class GgrsRunner:
         self.rollbacks += 1
         with span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
-            self.world = self.app.reg.load_state(stored)
+            self.world = self.app.reg.load_state(materialize(stored))
             self._world_checksum = checksum
             self.frame = frame
 
@@ -323,22 +354,27 @@ class GgrsRunner:
         adv = [r for r in run if isinstance(r, AdvanceRequest)]
         k = len(adv)
         identity = self.app.reg.is_identity_strategy()
+        if not hasattr(self._world_checksum, "to_int"):
+            # tolerate external writes of a bare uint32[2] device checksum
+            self._world_checksum = wrap_single_checksum(self._world_checksum)
         pre_world, pre_checksum = self.world, self._world_checksum
         pre_frame = self.frame
         if self.on_advance is not None:
             for i, a in enumerate(adv):
                 self.on_advance(frame_add(pre_frame, i + 1), a.inputs, a.status)
-        stacked = checks = None
+        stacked = None
+        batch_checks = None  # BatchChecks over this dispatch's stacked checksums
         skip = 0
-        cache_states = cache_checks = None
+        cache_states = cache_bc = None
         if self.spec_cache is not None and k > 0:
             got = self.spec_cache.lookup_seq(
                 self.frame, np.stack([a.inputs for a in adv])
             )
             if got is not None:
                 skip, cache_states, cache_checks = got
+                cache_bc = BatchChecks(cache_checks)
                 self.world = cache_states(skip - 1)
-                self._world_checksum = cache_checks[skip - 1]
+                self._world_checksum = cache_bc.ref(skip - 1)
                 self.frame = frame_add(self.frame, skip)
         # state feeding the LAST advance (used to speculate the next tick)
         last_adv_src = self.world
@@ -361,10 +397,11 @@ class GgrsRunner:
                     final, stacked, checks = self.app.resim_fn(
                         self.world, inputs, status, self.frame
                     )
-                if k - skip >= 2:
+                batch_checks = BatchChecks(checks)
+                if self.spec_cache is not None and k - skip >= 2:
                     last_adv_src = slice_frame(stacked, k - skip - 2)
                 self.world = final
-                self._world_checksum = checks[k - skip - 1]
+                self._world_checksum = batch_checks.ref(k - skip - 1)
                 self.frame = frame_add(self.frame, k - skip)
         with span("SaveWorld"):
             c = 0  # advances seen so far within the run
@@ -375,13 +412,19 @@ class GgrsRunner:
                 if c == 0:
                     state_s, cs = pre_world, pre_checksum
                 elif c <= skip:
-                    state_s, cs = cache_states(c - 1), cache_checks[c - 1]
+                    state_s, cs = cache_states(c - 1), cache_bc.ref(c - 1)
                 else:
-                    state_s = slice_frame(stacked, c - 1 - skip)
-                    cs = checks[c - 1 - skip]
-                stored = state_s if identity else self.app.reg.store_state(state_s)
+                    # defer the per-frame slice: the ring stores a handle into
+                    # the stacked buffer; slicing dispatches only on rollback
+                    state_s = LazySlice(stacked, c - 1 - skip)
+                    cs = batch_checks.ref(c - 1 - skip)
+                stored = (
+                    state_s
+                    if identity
+                    else self.app.reg.store_state(materialize(state_s))
+                )
                 self.ring.push(r.frame, (stored, cs))
-                r.cell.save(r.frame, _provider(cs))
+                r.cell.save(r.frame, cs.to_int)
         # hedge the live frame: if its inputs were (partly) predicted, fan out
         # candidate branches for the same transition (the branched program
         # already did this inside its own dispatch)
@@ -407,9 +450,11 @@ class GgrsRunner:
         k = inputs.shape[0]
         if k > K:
             raise ValueError(f"resim depth {k} exceeds canonical_depth {K}")
+        from .ops.resim import pad_repeat_last
+
         pad = K - k
-        inputs_p = np.concatenate([inputs, np.repeat(inputs[-1:], pad, axis=0)])             if pad else inputs
-        status_p = np.concatenate([status, np.repeat(status[-1:], pad, axis=0)])             if pad else status
+        inputs_p = pad_repeat_last(np.asarray(inputs), pad)
+        status_p = pad_repeat_last(np.asarray(status), pad)
         ib = np.broadcast_to(inputs_p[None], (B, *inputs_p.shape)).copy()
         sb = np.broadcast_to(status_p[None], (B, *status_p.shape)).copy()
         n_real = np.full((B,), k, np.int32)
@@ -432,20 +477,13 @@ class GgrsRunner:
             hedge_stacked = _jax.tree.map(lambda a: a[1:1 + m], stacked)
             self.spec_cache.fill_from_branched(
                 frame_add(self.frame, k - 1), cands,
-                hedge_stacked, np.asarray(checks[1:1 + m]),
+                hedge_stacked, checks[1:1 + m],
                 offset=k - 1, depth_eff=K - (k - 1),
             )
-        final0 = _jax.tree.map(lambda a: a[0], finals)
-        stacked0 = _jax.tree.map(lambda a: a[0, :k], stacked)
-        return final0, stacked0, checks[0, :k]
+        from .ops.resim import trim_frames
+        from .snapshot.lazy import tree_index
 
-
-def _provider(cs):
-    forced = []
-
-    def get() -> int:
-        if not forced:
-            forced.append(checksum_to_int(cs))
-        return forced[0]
-
-    return get
+        final0, (stacked0, checks0) = tree_index(
+            (finals, trim_frames((stacked, checks), k, axis=1)), 0
+        )
+        return final0, stacked0, checks0

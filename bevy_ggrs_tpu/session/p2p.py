@@ -43,6 +43,15 @@ from .protocol import PeerEndpoint
 from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 
 
+# absolute bound on un-acked send history (frames; ~68 s at 60 fps).  The
+# ack-driven trim below normally keeps these lists tiny, and a peer that acks
+# nothing eventually hits the disconnect timeout — but a peer whose
+# *keepalives* arrive while its acks are lost one-way would otherwise defeat
+# that timeout and grow the history without bound.  Oldest frames drop first;
+# a peer that far behind has lost the stream anyway.
+MAX_UNACKED_FRAMES = 4096
+
+
 def _min_ack(endpoints):
     """Oldest last-acked frame across CONNECTED endpoints.
 
@@ -388,6 +397,8 @@ class P2PSession:
             self._local_sent = [
                 p for p in self._local_sent if frame_gt(p[0], acked)
             ]
+        if len(self._local_sent) > MAX_UNACKED_FRAMES:
+            self._local_sent = self._local_sent[-MAX_UNACKED_FRAMES:]
         for fr in [f for f in self._local_checksums if frame_lt(f, horizon)]:
             del self._local_checksums[fr]
         for key in [k for k in self._remote_checksums if frame_lt(k[1], horizon)]:
@@ -415,6 +426,8 @@ class P2PSession:
             self._spectator_sent = [
                 p for p in self._spectator_sent if frame_gt(p[0], acked)
             ]
+        if len(self._spectator_sent) > MAX_UNACKED_FRAMES:
+            self._spectator_sent = self._spectator_sent[-MAX_UNACKED_FRAMES:]
 
     # -- desync detection ----------------------------------------------------
 

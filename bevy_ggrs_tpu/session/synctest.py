@@ -34,6 +34,7 @@ class SyncTestSession:
         input_delay: int = 0,
         max_prediction: int = 8,
         initial_frame: int = 0,
+        compare_interval: int = None,
     ):
         self._num_players = num_players
         self.input_shape = tuple(input_shape)
@@ -43,6 +44,15 @@ class SyncTestSession:
         self._max_prediction = max(max_prediction, check_distance + 1)
         self.current_frame = initial_frame
         self._age = 0  # ticks since session start (rollback warmup gate)
+        # Comparison cadence: checksum providers force a device->host pull,
+        # which on high-latency device links costs a flat round-trip (see
+        # snapshot/lazy.py).  Comparing every `compare_interval` ticks batches
+        # many frames' pulls into one transfer; detection is delayed by at
+        # most that many ticks (the error still names the exact mismatched
+        # frames).  None = auto: prompt (1) on CPU where pulls are memcpys,
+        # 32 on accelerator backends.
+        self._compare_interval = compare_interval
+        self._ticks_since_compare = 0
         # frame -> [P, *shape] effective (post-delay) confirmed inputs
         self._inputs: Dict[int, np.ndarray] = {}
         self._staged: Dict[int, np.ndarray] = {}
@@ -84,7 +94,10 @@ class SyncTestSession:
             missing = set(range(self._num_players)) - set(self._staged)
             raise InvalidRequestError(f"missing local input for players {missing}")
 
-        self._check_mismatches()
+        self._ticks_since_compare += 1
+        if self._ticks_since_compare >= self.compare_interval():
+            self._ticks_since_compare = 0
+            self._check_mismatches()
 
         # apply input delay: input staged now takes effect at frame+delay;
         # frames before the first delayed input see the default (zero) input
@@ -115,6 +128,27 @@ class SyncTestSession:
         self._gc()
         return requests
 
+    def compare_interval(self) -> int:
+        """Effective comparison cadence (resolves the auto default)."""
+        if self._compare_interval is None:
+            try:
+                import jax
+
+                self._compare_interval = (
+                    1 if jax.default_backend() == "cpu" else 32
+                )
+            except Exception:
+                self._compare_interval = 1
+        return self._compare_interval
+
+    def check_now(self) -> None:
+        """Force all pending checksum comparisons immediately (raises
+        :class:`MismatchedChecksumError` like ``advance_frame`` would).
+        Call at session teardown when running with a deferred
+        ``compare_interval``."""
+        self._ticks_since_compare = 0
+        self._check_mismatches()
+
     # -- internals ---------------------------------------------------------
 
     def _input_for(self, frame: int) -> np.ndarray:
@@ -144,9 +178,15 @@ class SyncTestSession:
             raise MismatchedChecksumError(self.current_frame, frames)
 
     def _gc(self) -> None:
-        # a frame can still receive saves until current passes it by d+1
-        horizon = frame_add(self.current_frame, -self.check_distance - 2)
-        for fr in [fr for fr in self._cells if frame_diff(fr, horizon) < 0]:
+        # a frame can still receive saves until current passes it by d+1;
+        # cells additionally survive the deferred-comparison window so no
+        # frame is ever dropped uncompared
+        cell_horizon = frame_add(
+            self.current_frame,
+            -self.check_distance - 2 - self.compare_interval(),
+        )
+        for fr in [fr for fr in self._cells if frame_diff(fr, cell_horizon) < 0]:
             del self._cells[fr]
+        horizon = frame_add(self.current_frame, -self.check_distance - 2)
         for fr in [fr for fr in self._inputs if frame_diff(fr, horizon) < 0]:
             del self._inputs[fr]

@@ -190,8 +190,12 @@ def world_checksum(reg: Registry, w: WorldState) -> jnp.ndarray:
 
 
 def checksum_to_int(cs) -> int:
-    """uint32[2] -> python int (the 64-bit cross-peer checksum value)."""
+    """uint32[2] (or a lazy ChecksumRef) -> python int (the 64-bit cross-peer
+    checksum value).  Forcing a ref pulls every pending batch in one transfer
+    (see snapshot/lazy.py)."""
     import numpy as np
 
+    if hasattr(cs, "to_int"):
+        return cs.to_int()
     a = np.asarray(cs, dtype=np.uint64)
     return int((a[0] << np.uint64(32)) | a[1])

@@ -1,0 +1,165 @@
+"""Lazy device->host views — the driver's transfer-amortization layer.
+
+The reference hands checksums to the session as plain integers because its
+whole pipeline is host-side (/root/reference/src/schedule_systems.rs:223-237).
+On TPU the checksum lives on device, and on high-latency links (the tunnel
+this framework is benched through) every device->host pull costs a FLAT
+round-trip (~tens of ms) regardless of payload size — while async dispatch
+costs ~0.06 ms.  Measured on the bench TPU: one pull of 1 tiny array and one
+pull of 32 arrays both cost ~70 ms; a second read of an already-pulled array
+costs ~0.04 ms (jax caches the host copy per-Array).
+
+Consequences, and the design here:
+
+- :class:`BatchChecks` wraps one dispatch's stacked ``uint32[k, 2]`` checksum
+  output and registers itself in a process-wide pending set.  Forcing ANY
+  instance pulls EVERY pending instance in a single ``jax.device_get`` call —
+  so the flat round-trip cost is paid once per *pull*, not once per frame.
+- :class:`ChecksumRef` is a light (batch, row) handle used wherever the
+  driver used to hold a per-frame device checksum; ``to_int()`` is the lazy
+  provider the session protocols consume.
+- :class:`LazySlice` defers ``stacked[i]`` materialization of per-frame saved
+  states: the snapshot ring stores (stacked-buffer, index) handles and only
+  issues the slicing dispatches for the one frame a rollback actually loads.
+
+All of this is also correct (and nearly free) on CPU, where device_get is a
+memcpy.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+import numpy as np
+
+
+class BatchChecks:
+    """One dispatch's stacked checksums (uint32[k, 2] on device), pulled to
+    host lazily and *collectively* (all pending instances in one transfer)."""
+
+    _pending: "weakref.WeakSet[BatchChecks]" = weakref.WeakSet()
+
+    __slots__ = ("_dev", "_host", "__weakref__")
+
+    def __init__(self, dev):
+        self._dev = dev
+        self._host: Optional[np.ndarray] = None
+        BatchChecks._pending.add(self)
+
+    def host(self) -> np.ndarray:
+        """uint64[k, 2] host copy; first call pulls every pending batch."""
+        if self._host is None:
+            BatchChecks.pull_pending()
+        return self._host
+
+    def ref(self, i: int) -> "ChecksumRef":
+        return ChecksumRef(self, i)
+
+    @classmethod
+    def pull_pending(cls) -> None:
+        """Pull every unforced batch in ONE transfer.
+
+        ``jax.device_get`` over a *list* issues one blocking round-trip per
+        array (measured ~53 ms each on the tunnel); instead the pending
+        batches are concatenated on device into a single ``[sum_k, 2]`` array
+        (one async dispatch) and pulled as ONE array (one round-trip)."""
+        import jax
+
+        pending = [b for b in cls._pending if b._host is None]
+        cls._pending.clear()
+        if not pending:
+            return
+        if len(pending) == 1:
+            pending[0]._host = np.asarray(
+                jax.device_get(pending[0]._dev), dtype=np.uint64
+            )
+            return
+        fused = _concat_rows(*[b._dev for b in pending])
+        host = np.asarray(jax.device_get(fused), dtype=np.uint64)
+        off = 0
+        for b in pending:
+            k = b._dev.shape[0]
+            b._host = host[off:off + k]
+            off += k
+
+
+def _concat_rows(*xs):
+    """Jitted [k_i, 2] -> [sum k_i, 2] concat (compiled once per shape tuple)."""
+    import jax
+
+    global _concat_rows_jit
+    if _concat_rows_jit is None:
+        import jax.numpy as jnp
+
+        _concat_rows_jit = jax.jit(lambda *ys: jnp.concatenate(ys, axis=0))
+    return _concat_rows_jit(*xs)
+
+
+_concat_rows_jit = None
+
+
+class ChecksumRef:
+    """Handle to row ``i`` of a :class:`BatchChecks` — the per-frame checksum."""
+
+    __slots__ = ("_batch", "_i")
+
+    def __init__(self, batch: BatchChecks, i: int):
+        self._batch = batch
+        self._i = i
+
+    def to_int(self) -> int:
+        """The 64-bit cross-peer checksum value (forces the batched pull)."""
+        a = self._batch.host()[self._i]
+        return int((a[0] << np.uint64(32)) | a[1])
+
+    def device(self):
+        """Lazy uint32[2] device row (a dispatch, not a transfer)."""
+        return self._batch._dev[self._i]
+
+    def __array__(self, dtype=None, copy=None):
+        a = self._batch.host()[self._i]
+        return np.asarray(a, dtype=dtype if dtype is not None else np.uint64)
+
+
+def wrap_single_checksum(cs) -> ChecksumRef:
+    """Wrap a bare uint32[2] device checksum as a 1-row batch ref."""
+    return BatchChecks(cs[None]).ref(0)
+
+
+class LazySlice:
+    """Deferred ``tree.map(a[i])`` over a stacked resim output — the ring
+    stores these so per-frame save slicing never dispatches unless loaded."""
+
+    __slots__ = ("_stacked", "_i")
+
+    def __init__(self, stacked, i: int):
+        self._stacked = stacked
+        self._i = i
+
+    def materialize(self):
+        return tree_index(self._stacked, self._i)
+
+
+def materialize(obj):
+    """LazySlice -> concrete pytree; anything else passes through."""
+    return obj.materialize() if isinstance(obj, LazySlice) else obj
+
+
+def tree_index(stacked, i: int):
+    """``tree.map(a[i])`` as ONE jitted dispatch.
+
+    Eager per-leaf indexing costs one device op round-trip per leaf (~1 ms
+    each through the tunnel); the jitted dynamic-index program slices every
+    leaf in a single dispatch."""
+    import jax
+
+    global _tree_index_jit
+    if _tree_index_jit is None:
+        _tree_index_jit = jax.jit(
+            lambda t, j: jax.tree.map(lambda a: a[j], t)
+        )
+    return _tree_index_jit(stacked, np.int32(i))
+
+
+_tree_index_jit = None

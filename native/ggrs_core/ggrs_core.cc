@@ -47,6 +47,12 @@ constexpr int NUM_SYNC_ROUNDTRIPS = 5;
 constexpr double SYNC_RETRY_S = 0.06, QUALITY_INTERVAL_S = 0.2,
                  KEEP_ALIVE_S = 0.2;
 constexpr int MAX_INPUTS_PER_PACKET = 64;
+/* absolute bound on un-acked send history (frames; ~68 s at 60 fps).  The
+ * ack-driven trim keeps these deques tiny normally, and a silent peer hits
+ * the disconnect timeout — but a peer whose keepalives arrive while its acks
+ * are lost one-way defeats that timeout; without this cap local_sent /
+ * spectator_sent would grow unboundedly.  Oldest frames drop first. */
+constexpr int MAX_UNACKED_FRAMES = 4096;
 
 struct Writer {
   std::vector<uint8_t> buf;
@@ -773,6 +779,8 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
     while (!s->local_sent.empty() && acked != NULL_FRAME &&
            frame_le(s->local_sent.front().first, acked))
       s->local_sent.pop_front();
+  while ((int)s->local_sent.size() > MAX_UNACKED_FRAMES)
+    s->local_sent.pop_front();
   for (auto it = s->local_checksums.begin(); it != s->local_checksums.end();)
     it = frame_lt(it->first, horizon) ? s->local_checksums.erase(it) : std::next(it);
   for (auto it = s->remote_checksums.begin(); it != s->remote_checksums.end();)
@@ -816,12 +824,14 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
              frame_le(s->spectator_sent.front().first, acked))
         s->spectator_sent.pop_front();
       /* hard cap: an ACKING spectator >8 chunks (~8.5 s at 60fps) behind
-       * starts losing the oldest frames (it should have been catching up);
-       * never applied while one is still syncing (disconnect timeout bounds
-       * that state, so memory stays bounded either way) */
+       * starts losing the oldest frames (it should have been catching up) */
       while ((int)s->spectator_sent.size() > 8 * MAX_INPUTS_PER_PACKET)
         s->spectator_sent.pop_front();
     }
+    /* absolute bound, applied even while a connected spectator has acked
+     * nothing (keepalives alive, acks lost one-way) — see MAX_UNACKED_FRAMES */
+    while ((int)s->spectator_sent.size() > MAX_UNACKED_FRAMES)
+      s->spectator_sent.pop_front();
   }
   *n_req_words = rw;
   *n_input_bytes = ib;

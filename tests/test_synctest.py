@@ -200,3 +200,83 @@ def test_session_restart_resets_driver():
     for _ in range(4):
         runner.tick()
     assert runner.frame == 4
+
+
+# -- deferred comparison (compare_interval > 1; the accelerator default) ----
+# The CPU auto default is 1 (prompt), so these pin the deferred path
+# explicitly: batching, the widened cell GC horizon, check_now, and the
+# runner's end-of-run / session-swap flush.
+
+
+def _deferred_runner(interval, check_distance=3):
+    app = make_counter_app()
+    session = SyncTestSession(
+        num_players=1, input_shape=(), input_dtype=np.uint8,
+        check_distance=check_distance, compare_interval=interval,
+    )
+    mismatches = []
+    runner = GgrsRunner(app, session, on_mismatch=mismatches.append)
+    return runner, session, mismatches
+
+
+def _inject_divergence(runner):
+    runner.world = dataclasses.replace(
+        runner.world,
+        comps={**runner.world.comps,
+               "counter": runner.world.comps["counter"] + 1000},
+    )
+    runner._world_checksum = runner.app.checksum_fn(runner.world)
+
+
+def test_deferred_compare_batches_and_still_detects():
+    runner, session, mismatches = _deferred_runner(interval=8)
+    for _ in range(10):
+        runner.tick()
+    assert mismatches == []
+    _inject_divergence(runner)
+    bad_frame = runner.frame
+    # detection is deferred but must land within one compare interval, and
+    # the widened cell GC horizon must keep the frames alive until compared
+    for i in range(session.compare_interval() + session.check_distance + 2):
+        runner.tick()
+        if mismatches:
+            break
+    assert mismatches, "deferred comparison never fired"
+    assert any(f >= bad_frame - session.check_distance
+               for f in mismatches[0].mismatched_frames)
+
+
+def test_check_now_forces_pending_comparisons():
+    runner, session, mismatches = _deferred_runner(interval=64)
+    for _ in range(10):
+        runner.tick()
+    _inject_divergence(runner)
+    for _ in range(session.check_distance + 1):
+        runner.tick()  # divergent resim saves recorded, not yet compared
+    assert mismatches == []  # interval=64: nothing compared yet
+    with pytest.raises(Exception):
+        session.check_now()
+
+
+def test_runner_finish_flushes_deferred_comparisons():
+    runner, session, mismatches = _deferred_runner(interval=64)
+    for _ in range(10):
+        runner.tick()
+    _inject_divergence(runner)
+    for _ in range(session.check_distance + 1):
+        runner.tick()
+    assert mismatches == []
+    runner.finish()  # end-of-run flush routes to on_mismatch
+    assert mismatches
+
+
+def test_session_swap_flushes_deferred_comparisons():
+    runner, session, mismatches = _deferred_runner(interval=64)
+    for _ in range(10):
+        runner.tick()
+    _inject_divergence(runner)
+    for _ in range(session.check_distance + 1):
+        runner.tick()
+    assert mismatches == []
+    runner.set_session(None)  # replacing the session must not drop checks
+    assert mismatches
