@@ -53,18 +53,25 @@ def build_app(n_types: int, n_entities: int):
     return app
 
 
-def bench(label, fn, iters):
+def bench(label, fn, iters, passes=3):
+    """Median-of-`passes` timed loops (criterion-style; spread in the JSON)."""
+    import statistics
+
     import jax
 
     jax.block_until_ready(fn())  # warmup/compile
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = fn()
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
+    samples = []
+    for _ in range(passes):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn()
+        jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) / iters)
+    dt = statistics.median(samples)
+    spread = (max(samples) - min(samples)) / dt if dt else 0.0
     print(json.dumps({"metric": label, "value": round(dt * 1e6, 2),
-                      "unit": "us/iter"}))
+                      "unit": "us/iter", "spread": round(spread, 3)}))
     return dt
 
 

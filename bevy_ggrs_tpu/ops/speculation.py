@@ -83,11 +83,7 @@ class SpeculationCache:
                 checks[b],
             )
         self._cache[start_frame] = (depth, entry)
-        # trim old start frames
-        for f in sorted(self._cache):
-            if len(self._cache) <= self.config.max_cached_frames:
-                break
-            del self._cache[f]
+        self._trim()
 
     def fill_from_branched(self, start_frame: int, cands: np.ndarray,
                            stacked_b, checks_b, offset: int, depth_eff: int) -> None:
@@ -107,10 +103,7 @@ class SpeculationCache:
             entry[key] = (stacked_slice, checks_b[b, offset:offset + depth_eff])
         self.branches_evaluated += cands.shape[0] * depth_eff
         self._cache[start_frame] = (depth_eff, entry)
-        for f in sorted(self._cache):
-            if len(self._cache) <= self.config.max_cached_frames:
-                break
-            del self._cache[f]
+        self._trim()
 
     def lookup_seq(self, start_frame: int, inputs_seq: np.ndarray) -> Optional[Tuple]:
         """Longest cached prefix for advancing ``start_frame`` with the frame
@@ -147,6 +140,33 @@ class SpeculationCache:
             return None
         d, states_fn, checks = got
         return states_fn(0), checks[0]
+
+    def _trim(self) -> None:
+        """Evict the OLDEST start frames past the cap, under wrapping frame
+        order (a plain ``sorted()`` would evict the newest at the i32 wrap)."""
+        from ..utils.frames import frame_lt
+
+        while len(self._cache) > self.config.max_cached_frames:
+            oldest = next(iter(self._cache))
+            for f in self._cache:
+                if frame_lt(f, oldest):
+                    oldest = f
+            del self._cache[oldest]
+
+    def invalidate_after(self, frame: int) -> None:
+        """Drop entries whose base state a rollback to ``frame`` invalidates.
+
+        An entry for start_frame s was speculated from the live state at s.
+        A rollback that loads frame f re-simulates every frame after f with
+        corrected inputs, so entries with s > f sit on superseded bases —
+        their *inputs* can still match a later lookup (the candidate row is
+        the same), which would serve bit-stale states and desync the
+        speculating peer from a plain one.  The entry at s == f stays valid:
+        its base is exactly the ring snapshot the load restores."""
+        from ..utils.frames import frame_gt
+
+        for s in [s for s in self._cache if frame_gt(s, frame)]:
+            del self._cache[s]
 
     def clear(self) -> None:
         self._cache.clear()

@@ -343,6 +343,10 @@ class GgrsRunner:
             self.world = self.app.reg.load_state(materialize(stored))
             self._world_checksum = checksum
             self.frame = frame
+        if self.spec_cache is not None:
+            # branches hedged from now-superseded predicted states must not
+            # serve future lookups (see SpeculationCache.invalidate_after)
+            self.spec_cache.invalidate_after(frame)
 
     def _run_batch(self, run: List[GgrsRequest]) -> None:
         """Execute a maximal Advance/Save run as one fused device call.
@@ -376,10 +380,15 @@ class GgrsRunner:
                 self.world = cache_states(skip - 1)
                 self._world_checksum = cache_bc.ref(skip - 1)
                 self.frame = frame_add(self.frame, skip)
-        # state feeding the LAST advance (used to speculate the next tick)
+        # state feeding the LAST advance (used to speculate the next tick).
+        # With a full cache hit (skip == k) self.world is already the
+        # POST-advance state: the pre-advance source is the previous cached
+        # frame, or for a single served advance the batch's entry state
+        # (speculating from the post-advance state would double-advance the
+        # hedge branches — states one frame ahead of their labels)
         last_adv_src = self.world
-        if skip == k and skip >= 2:
-            last_adv_src = cache_states(skip - 2)
+        if skip == k:
+            last_adv_src = cache_states(skip - 2) if skip >= 2 else pre_world
         use_branched = (
             self.spec_cache is not None and self.app.canonical_branches is not None
         )
@@ -435,7 +444,7 @@ class GgrsRunner:
             and np.any(adv[-1].status == InputStatus.PREDICTED)
         ):
             self.spec_cache.speculate(
-                last_adv_src, self.frame - 1, adv[-1].inputs
+                last_adv_src, frame_add(self.frame, -1), adv[-1].inputs
             )
 
     def _dispatch_branched(self, inputs, status, last_adv):

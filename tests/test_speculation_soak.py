@@ -1,0 +1,121 @@
+"""Speculation equivalence soak: randomized games over a lossy network, a
+hedging peer vs a plain peer, in BOTH dispatch modes (fast per-length
+programs and the canonical-branched bit-determinism program).  Speculation
+is a pure latency optimization — it must never change a single bit of
+state, so the peers' checksums must agree exactly while the cache takes
+real hits (the reference has no analog; SURVEY §2.4 "Speculation")."""
+
+import time
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    SpeculationConfig,
+    pad_candidates,
+)
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+
+
+def _run_game(mode: str, seed: int, ticks: int = 250):
+    """Two peers, random input streams, peer 0 hedging; returns the pair of
+    runners after `ticks` jittered host ticks."""
+    net = ChannelNetwork(latency_hops=2, loss=0.1, seed=seed, jitter_hops=2)
+    socks = [net.endpoint("a"), net.endpoint("b")]
+    rngs = [np.random.default_rng(1000 * seed + i) for i in range(2)]
+    runners = []
+    for i in range(2):
+        if mode == "canonical-branched":
+            app = box_game.make_app(num_players=2)
+            app.canonical_depth = 10
+            app.canonical_branches = 9  # lane 0 + all 8 hedge candidates
+        else:
+            app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .with_max_prediction_window(8)
+            .with_disconnect_timeout(60.0)
+            .with_disconnect_notify_delay(30.0)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a")
+        )
+        session = b.start_p2p_session(socks[i])
+        spec = (
+            SpeculationConfig(
+                candidates_fn=pad_candidates(2, [1 - i], list(range(8))),
+                depth=4,
+            )
+            if i == 0
+            else None
+        )
+
+        def read_inputs(handles, i=i):
+            # hold inputs for random stretches: realistic pad behavior that
+            # both mispredicts (on flips) and rewards hedging (on holds)
+            return {h: np.uint8(rngs[i].integers(0, 8)) for h in handles}
+
+        runners.append(
+            GgrsRunner(app, session, read_inputs=read_inputs, speculation=spec)
+        )
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(
+            r.session.current_state() == SessionState.RUNNING for r in runners
+        ):
+            break
+        time.sleep(0.002)
+    assert all(
+        r.session.current_state() == SessionState.RUNNING for r in runners
+    )
+
+    dt_rng = np.random.default_rng(seed)
+    for _ in range(ticks):
+        net.deliver()
+        for r in runners:
+            r.update(DT * float(dt_rng.uniform(0.5, 1.5)))
+    return net, runners
+
+
+@pytest.mark.parametrize("mode", ["fast", "canonical-branched"])
+@pytest.mark.parametrize("seed", [3, 11])
+def test_hedging_peer_bit_identical_to_plain_peer(mode, seed):
+    net, runners = _run_game(mode, seed)
+    # both progressed well past the sync handshake
+    assert all(r.frame > 100 for r in runners)
+    # tick evenly until both rings hold a common frame, then compare its
+    # CONFIRMED checksum (both peers' view of the same simulated moment)
+    common_frames = ()
+    for _ in range(120):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+        common_frames = sorted(
+            set(runners[0].ring.frames()) & set(runners[1].ring.frames())
+        )
+        confirmed = min(r.confirmed for r in runners)
+        common_frames = [f for f in common_frames if f <= confirmed]
+        if common_frames:
+            break
+    assert common_frames, "peers' snapshot rings never overlapped"
+    common = common_frames[-1]
+    cs = [checksum_to_int(r.ring.peek(common)[1]) for r in runners]
+    assert cs[0] == cs[1], (
+        f"speculating and plain peers diverged at frame {common} "
+        f"({mode}, seed {seed})"
+    )
+    # the soak is only meaningful if hedging actually engaged
+    stats = runners[0].stats()
+    assert stats["speculation_hits"] + stats["speculation_misses"] > 0

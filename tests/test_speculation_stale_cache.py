@@ -1,0 +1,112 @@
+"""Regression: speculative branches hedged from predicted states must be
+invalidated when a rollback corrects those states (runner._load calls
+SpeculationCache.invalidate_after).  Before the fix, a deep rollback could
+look up an entry whose *inputs* matched but whose base state was a stale
+prediction, silently desyncing the speculating peer — caught by the
+randomized soak (test_speculation_soak.py); this file pins the minimal
+deterministic schedule that reproduced it (diverged at the second rollback
+with 2 cache hits)."""
+
+import numpy as np
+
+from bevy_ggrs_tpu import GgrsRunner, SpeculationConfig, pad_candidates
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.events import InputStatus
+from bevy_ggrs_tpu.session.requests import (
+    AdvanceRequest,
+    LoadRequest,
+    SaveRequest,
+    SaveCell,
+)
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+
+class _ScriptedSession:
+    """Minimal session double: the test feeds request lists directly."""
+
+    def __init__(self):
+        self.conf = -1
+
+    def max_prediction(self):
+        return 8
+
+    def rollback_window(self):
+        return 8
+
+    def confirmed_frame(self):
+        return self.conf
+
+    def _on_cell_saved(self, frame, provider):
+        pass
+
+
+def _mk(spec):
+    app = box_game.make_app(num_players=2)
+    r = GgrsRunner(app, read_inputs=lambda hs: {}, speculation=spec)
+    r.session = _ScriptedSession()
+    return r
+
+
+def test_rollback_invalidates_branches_from_predicted_states():
+    spec = SpeculationConfig(
+        candidates_fn=pad_candidates(2, [1], list(range(8))),
+        depth=4,
+        max_cached_frames=16,  # keep old edges alive so stale hits can occur
+    )
+    a = _mk(spec)  # speculating
+    b = _mk(None)  # plain reference
+
+    rng = np.random.default_rng(0)
+    true_inp = {}
+
+    def tin(f):
+        if f not in true_inp:
+            true_inp[f] = rng.integers(0, 8, size=2).astype(np.uint8)
+        return true_inp[f]
+
+    def adv(f, predicted_from=None):
+        inp = tin(f).copy()
+        st = np.full((2,), InputStatus.CONFIRMED, np.int8)
+        if predicted_from is not None:
+            inp[1] = tin(predicted_from)[1]  # repeat-last prediction
+            st[1] = InputStatus.PREDICTED
+        return AdvanceRequest(inp, st)
+
+    def batch(reqs, confirmed):
+        for r in (a, b):
+            r.session.conf = confirmed
+            r._handle_requests(list(reqs))
+
+    def assert_rings_agree(tag):
+        for f in set(a.ring.frames()) & set(b.ring.frames()):
+            ca = checksum_to_int(a.ring.peek(f)[1])
+            cb = checksum_to_int(b.ring.peek(f)[1])
+            assert ca == cb, f"diverged at frame {f} ({tag})"
+
+    conf, last_real, cur = -1, 0, 0
+    for t in range(1, 120):
+        if cur - last_real < 8:  # prediction-threshold stall bound
+            batch(
+                [SaveRequest(cur, SaveCell(a.session, cur)),
+                 adv(cur + 1, predicted_from=last_real)],
+                conf,
+            )
+            cur += 1
+            assert_rings_agree(f"live tick {t}")
+        if t % 3 == 0:
+            j = int(rng.integers(1, 4))
+            newconf = min(last_real + j, cur - 1)
+            if newconf > last_real:
+                target, k = last_real, cur - last_real
+                reqs = [LoadRequest(target)]
+                for i in range(1, k + 1):
+                    f = target + i
+                    pf = None if f <= newconf else newconf
+                    reqs.append(adv(f, predicted_from=pf))
+                    reqs.append(SaveRequest(f, SaveCell(a.session, f)))
+                batch(reqs, target)  # confirmed trails the load target
+                last_real = conf = newconf
+                assert_rings_agree(f"rollback tick {t}")
+
+    # the scenario must actually exercise the cache to mean anything
+    assert a.spec_cache.hits >= 1
