@@ -167,17 +167,17 @@ def bench_device():
     }
 
 
-def bench_numpy_baseline():
+def bench_numpy_baseline(n_entities=N_ENTITIES, iters=ITERS):
     from bench_baselines import NumpyStressSim
 
-    sim = NumpyStressSim(N_ENTITIES, seed=0)
+    sim = NumpyStressSim(n_entities, seed=0)
     sim.resim(DEPTH)  # warmup
     samples = []
     for _ in range(REPS):
         t0 = time.perf_counter()
-        for _ in range(ITERS):
+        for _ in range(iters):
             sim.resim(DEPTH)
-        samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+        samples.append(DEPTH * iters / (time.perf_counter() - t0))
     return _median_spread(samples)
 
 
@@ -190,6 +190,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     d = bench_device()
     cpu_fps, cpu_spread = bench_numpy_baseline()
+    cpu_fps_big, _ = bench_numpy_baseline(N_ENTITIES_BIG, iters=5)
     result = {
         "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
         "value": round(d["fps"], 1),
@@ -201,6 +202,8 @@ def main():
         "baseline_spread": round(cpu_spread, 3),
         "resim_fps_100k_entities": round(d["fps_big"], 1),
         "resim_fps_100k_spread": round(d["spread_big"], 3),
+        "vs_baseline_100k": round(d["fps_big"] / cpu_fps_big, 2),
+        "baseline_numpy_cpu_fps_100k": round(cpu_fps_big, 1),
         "speculative_lane0_useful_fps": round(d["spec_fps"], 1),
         "speculative_lane_frames_per_sec": round(
             d["spec_fps"] * SPEC_BRANCHES, 1

@@ -21,6 +21,7 @@ from .session import (
     SpectatorSession,
     SessionBuilder,
     UdpNonBlockingSocket,
+    TcpNonBlockingSocket,
     InputStatus,
     SessionState,
     PlayerType,

@@ -145,10 +145,17 @@ class App:
     # -- state -------------------------------------------------------------
 
     def init_state(self) -> WorldState:
-        """Build the initial WorldState (runs the setup function if set)."""
+        """Build the initial WorldState (runs the setup function if set).
+
+        Lossy snapshot strategies make the stored representation canonical
+        (ops/resim.advance round-trips each frame); the INITIAL state gets
+        the same store->load round-trip so the frame-0 snapshot restores
+        exactly the state the first advance ran from."""
         w = self.reg.init_state()
         if self._setup is not None:
             w = self._setup(w)
+        if not self.reg.is_identity_strategy():
+            w = self.reg.load_state(self.reg.store_state(w))
         return w
 
     def zero_inputs(self) -> np.ndarray:

@@ -90,8 +90,14 @@ def make_app(
     fps: int = 60,
     checksum: bool = True,
     seed: int = 0,
+    quantize: bool = False,
 ) -> App:
-    """Build the particles stress App (capacity sized for rate x ttl)."""
+    """Build the particles stress App (capacity sized for rate x ttl).
+
+    ``quantize`` stores the float columns' ring snapshots in bf16 — the
+    registration-strategy A/B knob of the reference's ``--reflect`` flag
+    (/root/reference/examples/stress_tests/particles.rs:169-201), exercising
+    the only non-identity Strategy under checksums/desync detection."""
     if capacity is None:
         capacity = rate * (ttl + 8) + 64  # steady state + rollback headroom
     app = App(
@@ -102,8 +108,13 @@ def make_app(
         input_dtype=np.uint8,
         seed=seed,
     )
-    app.rollback_component("pos", (3,), jnp.float32, checksum=checksum)
-    app.rollback_component("vel", (3,), jnp.float32, checksum=checksum)
+    from ..snapshot.strategy import CopyStrategy, QuantizeStrategy
+
+    strat = QuantizeStrategy(jnp.bfloat16) if quantize else CopyStrategy
+    app.rollback_component("pos", (3,), jnp.float32, checksum=checksum,
+                           strategy=strat)
+    app.rollback_component("vel", (3,), jnp.float32, checksum=checksum,
+                           strategy=strat)
     app.rollback_component("ttl", (), jnp.int32, checksum=checksum)
     app.rollback_resource("rng_counter", jnp.uint32(0), checksum=checksum)
     app.set_step(make_step(app, rate, ttl))

@@ -95,7 +95,17 @@ def advance(
     retire = jnp.asarray(frame, jnp.int32) - jnp.int32(retention)
     state = despawn_confirmed(reg, state, retire)
     ctx = _make_ctx(inputs, status, frame, retire, fps, seed)
-    return step_fn(state, ctx)
+    state = step_fn(state, ctx)
+    if not reg.is_identity_strategy():
+        # lossy snapshot strategies (e.g. QuantizeStrategy) make the STORED
+        # representation canonical: round-trip the live state through
+        # store->load every frame so a resim from a restored snapshot is
+        # bit-identical to the live pass (otherwise SyncTest — and any two
+        # peers with different rollback depths — would mismatch by
+        # construction).  Fuses into the step program; identity strategies
+        # compile to nothing here.
+        state = reg.load_state(reg.store_state(state))
+    return state
 
 
 def resim(

@@ -58,6 +58,10 @@ class GgrsRunner:
         self.on_advance = on_advance  # (frame, inputs, status) per AdvanceFrame
         self.on_confirmed = on_confirmed  # (frame) when confirmed advances
         self.world = initial_state if initial_state is not None else app.init_state()
+        if initial_state is not None and not app.reg.is_identity_strategy():
+            # same canonicalization App.init_state applies: the frame-0
+            # snapshot must restore exactly the live state (lossy strategies)
+            self.world = app.reg.load_state(app.reg.store_state(self.world))
         self._world_checksum = wrap_single_checksum(app.checksum_fn(self.world))
         self.ring: SnapshotRing = SnapshotRing(depth=8)
         self.frame = 0  # RollbackFrameCount

@@ -21,7 +21,7 @@ from .requests import SaveRequest, LoadRequest, AdvanceRequest, SaveCell, GgrsRe
 from .synctest import SyncTestSession
 from .input_queue import InputQueue
 from .time_sync import TimeSync
-from .transport import UdpNonBlockingSocket, NonBlockingSocket
+from .transport import TcpNonBlockingSocket, UdpNonBlockingSocket, NonBlockingSocket
 from .p2p import P2PSession
 from .spectator import SpectatorSession
 from .builder import SessionBuilder
@@ -55,6 +55,7 @@ __all__ = [
     "InputQueue",
     "TimeSync",
     "UdpNonBlockingSocket",
+    "TcpNonBlockingSocket",
     "NonBlockingSocket",
     "P2PSession",
     "SpectatorSession",

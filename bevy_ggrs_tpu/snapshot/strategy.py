@@ -44,10 +44,12 @@ ReflectStrategy = Strategy()
 def QuantizeStrategy(stored_dtype=jnp.bfloat16) -> Strategy:
     """Store snapshots in a narrower dtype to cut ring HBM usage.
 
-    Lossy: rolling back through a quantized snapshot re-simulates from the
-    quantized state, which is still deterministic (same snapshot -> same
-    resim) and therefore checksum-safe within a session, but changes values
-    vs. an identity-strategy run.  Use for visual-only state."""
+    Lossy vs an identity-strategy run, but deterministic AND checksum-safe:
+    the stored representation is canonical — the advance pipeline
+    round-trips the live state through store->load every frame
+    (ops/resim.advance), so live and restored-from-snapshot passes are
+    bit-identical (SyncTest-proven; without the round-trip the live pass
+    would drift from the resim pass and mismatch by construction)."""
     return Strategy(
         store=lambda a: a.astype(stored_dtype),
         load=lambda a: a,  # re-cast to the live dtype happens in load_state

@@ -35,10 +35,14 @@ def main():
     ap.add_argument("--players", nargs="*", default=["local", "local"])
     ap.add_argument("--frames", type=int, default=600)
     ap.add_argument("--continue-after-desync", action="store_true")
+    ap.add_argument("--quantize", action="store_true",
+                    help="store float snapshots as bf16 (the strategy A/B "
+                         "knob; the reference's --reflect analog)")
     args = ap.parse_args()
 
     app = particles.make_app(rate=args.rate, ttl=args.ttl,
-                             num_players=max(len(args.players), 1))
+                             num_players=max(len(args.players), 1),
+                             quantize=args.quantize)
     b = SessionBuilder.for_app(app).with_num_players(app.num_players)
 
     def on_event(e):
