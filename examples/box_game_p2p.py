@@ -49,13 +49,21 @@ def main():
                          "required when peers' float rounding must match "
                          "exactly; costs max_prediction+2 frames of compute "
                          "per dispatch (cheap on TPU, heavy on CPU)")
+    ap.add_argument("--tcp", action="store_true",
+                    help="framed-TCP transport instead of UDP (for networks "
+                         "that block UDP; all peers must agree)")
     args = ap.parse_args()
 
     app = box_game.make_app(
         num_players=len(args.players), fps=args.fps,
         canonical_depth=(args.max_prediction + 2) if args.canonical else None,
     )
-    sock = UdpNonBlockingSocket(args.local_port)
+    if args.tcp:
+        from bevy_ggrs_tpu import TcpNonBlockingSocket
+
+        sock = TcpNonBlockingSocket(args.local_port)
+    else:
+        sock = UdpNonBlockingSocket(args.local_port)
     b = (
         SessionBuilder.for_app(app)
         .with_num_players(len(args.players))
