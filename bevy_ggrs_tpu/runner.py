@@ -170,7 +170,8 @@ class GgrsRunner:
             self.accumulator = 0.0
             return
         if hasattr(self.session, "poll_remote_clients"):
-            self.session.poll_remote_clients()
+            with span("PollRemoteClients"):
+                self.session.poll_remote_clients()
             self._drain_events()
         while self.accumulator >= fps_delta:
             self.accumulator -= fps_delta
@@ -254,7 +255,8 @@ class GgrsRunner:
         for handle, value in self.read_inputs(self.local_players).items():
             s.add_local_input(handle, value)
         try:
-            requests = s.advance_frame()
+            with span("SessionAdvanceFrame"):
+                requests = s.advance_frame()
         except MismatchedChecksumError as e:
             trace_log("SyncTest mismatch: %s", e)
             if self.on_mismatch is not None:
@@ -269,7 +271,8 @@ class GgrsRunner:
             for handle, value in self.read_inputs(self.local_players).items():
                 s.add_local_input(handle, value)
         try:
-            requests = s.advance_frame()
+            with span("SessionAdvanceFrame"):
+                requests = s.advance_frame()
         except PredictionThresholdError:
             trace_log("frame %d skipped: prediction threshold", self.frame)
             self.stalled_frames += 1

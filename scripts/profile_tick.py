@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Capture a device profile of driver ticks — the perf-diagnosis tool.
+
+    python scripts/profile_tick.py --mode synctest --entities 2000 \
+        --ticks 50 --logdir /tmp/ggrs_trace
+
+Runs warmup ticks (compiles outside the capture), then records `--ticks`
+ticks under ``jax.profiler.trace``; view the trace with TensorBoard/XProf.
+Alongside the device trace it prints a host-side wall-time split per
+runner-tick from the driver's span ring (utils/tracing.py): poll, session
+step (SyncTest checksum comparison lives here), request handling with its
+dispatch sub-phases, and unattributed host time — so host-bound vs
+device-bound is obvious at a glance.  This is the tool that pins whether a
+slow driver is paying link round-trips (docs/tpu_notes.md §3b) or real
+compute."""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+import numpy as np
+
+# spans nested inside HandleRequests (reported indented; excluded from the
+# top-level sum so nothing is double-counted)
+_SUB_SPANS = ("LoadWorld", "AdvanceWorld", "SaveWorld")
+_TOP_SPANS = ("PollRemoteClients", "SessionAdvanceFrame", "HandleRequests")
+
+
+def build_runner(mode: str, entities: int, check_distance: int):
+    from bevy_ggrs_tpu import GgrsRunner, SyncTestSession
+    from bevy_ggrs_tpu.models import stress
+
+    app = stress.make_app(entities, capacity=entities)
+    if mode == "synctest":
+        session = SyncTestSession(
+            num_players=2, input_shape=(), input_dtype=np.uint8,
+            check_distance=check_distance,
+        )
+        return [GgrsRunner(app, session)], lambda: None
+    # p2p pair over the in-process channel network
+    from bevy_ggrs_tpu import PlayerType, SessionBuilder, SessionState
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    net = ChannelNetwork(latency_hops=2)
+    socks = [net.endpoint("a"), net.endpoint("b")]
+    runners = []
+    for i in range(2):
+        app_i = stress.make_app(entities, capacity=entities)
+        b = (SessionBuilder.for_app(app_i).with_input_delay(1)
+             .with_disconnect_timeout(60.0).with_disconnect_notify_delay(30.0)
+             .add_player(PlayerType.LOCAL, i)
+             .add_player(PlayerType.REMOTE, 1 - i, "b" if i == 0 else "a"))
+        runners.append(GgrsRunner(app_i, b.start_p2p_session(socks[i])))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING
+               for r in runners):
+            break
+        time.sleep(0.001)
+    if not all(r.session.current_state() == SessionState.RUNNING
+               for r in runners):
+        raise SystemExit("p2p pair never reached RUNNING — nothing to profile")
+    return runners, net.deliver
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("synctest", "p2p"), default="synctest")
+    ap.add_argument("--entities", type=int, default=2000)
+    ap.add_argument("--check-distance", type=int, default=7)
+    ap.add_argument("--ticks", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=50)
+    ap.add_argument("--logdir", default="/tmp/ggrs_trace")
+    args = ap.parse_args()
+
+    import jax
+
+    from bevy_ggrs_tpu.utils.tracing import clear_trace_events, get_trace_events
+
+    runners, deliver = build_runner(args.mode, args.entities,
+                                    args.check_distance)
+
+    for _ in range(args.warmup):
+        deliver()
+        for r in runners:
+            r.tick()
+
+    clear_trace_events()
+    t0 = time.perf_counter()
+    with runners[0].profile(args.logdir):
+        for _ in range(args.ticks):
+            deliver()
+            for r in runners:
+                r.tick()
+        for r in runners:
+            jax.block_until_ready(r.world)
+    wall = time.perf_counter() - t0
+
+    runner_ticks = args.ticks * len(runners)
+    per_span: dict = {}
+    for name, ts, te in get_trace_events():
+        per_span[name] = per_span.get(name, 0.0) + (te - ts)
+    print(f"platform: {jax.devices()[0].platform}")
+    print(f"{args.ticks} ticks x {len(runners)} runner(s) in {wall:.3f}s -> "
+          f"{args.ticks / wall:.1f} ticks/s "
+          f"({runner_ticks / wall:.1f} runner-ticks/s)")
+    top_total = 0.0
+    for name in _TOP_SPANS:
+        if name not in per_span:
+            continue
+        total = per_span[name]
+        top_total += total
+        print(f"  {name:20s} {total * 1e3 / runner_ticks:8.3f} ms/runner-tick")
+        if name == "HandleRequests":
+            for sub in _SUB_SPANS:
+                if sub in per_span:
+                    print(f"    {sub:18s} "
+                          f"{per_span[sub] * 1e3 / runner_ticks:8.3f} "
+                          f"ms/runner-tick")
+    print(f"  {'(unattributed host)':20s} "
+          f"{(wall - top_total) * 1e3 / runner_ticks:8.3f} ms/runner-tick")
+    print(f"device trace written to {args.logdir} (view with xprof/"
+          f"tensorboard)")
+
+
+if __name__ == "__main__":
+    main()
