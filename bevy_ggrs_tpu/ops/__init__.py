@@ -1,3 +1,4 @@
+from .batch import make_batched_resim_fn, stack_worlds, unstack_world
 from .variant_probe import probe_program_variants, VariantProbeReport
 from .resim import (
     StepCtx,
@@ -11,6 +12,9 @@ from .resim import (
 )
 
 __all__ = [
+    "make_batched_resim_fn",
+    "stack_worlds",
+    "unstack_world",
     "probe_program_variants",
     "VariantProbeReport",
     "StepCtx",

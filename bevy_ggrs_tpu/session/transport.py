@@ -236,6 +236,9 @@ class TcpNonBlockingSocket:
     # -- NonBlockingSocket protocol ----------------------------------------
 
     def send_to(self, data: bytes, addr) -> None:
+        """Queue one datagram to the peer listening at ``addr`` (dials on
+        first use; drops the connection on a dead socket so the next send
+        re-dials — UDP-like fire-and-forget at the datagram layer)."""
         addr = tuple(addr)
         if addr not in self._conns:
             self._dial(addr)
@@ -248,6 +251,8 @@ class TcpNonBlockingSocket:
             del self._conns[addr]
 
     def receive_all(self) -> List[Tuple[Any, bytes]]:
+        """Drain every complete datagram -> [(peer_listen_addr, bytes)];
+        also accepts/promotes inbound connections and flushes send backlogs."""
         self._accept_all()
         out: List[Tuple[Any, bytes]] = []
         # promote pending accepted conns once their hello frame arrives
@@ -314,6 +319,7 @@ class TcpNonBlockingSocket:
         return out
 
     def close(self) -> None:
+        """Close the listener and every connection."""
         for conn in self._conns.values():
             conn.close()
         for conn in self._pending:

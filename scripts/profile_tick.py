@@ -101,8 +101,12 @@ def main():
             deliver()
             for r in runners:
                 r.tick()
+        # device drain: on accelerators the per-span numbers above measure
+        # async SUBMISSION only — queued device compute is paid here
+        t_drain = time.perf_counter()
         for r in runners:
             jax.block_until_ready(r.world)
+        drain = time.perf_counter() - t_drain
     wall = time.perf_counter() - t0
 
     runner_ticks = args.ticks * len(runners)
@@ -126,8 +130,12 @@ def main():
                     print(f"    {sub:18s} "
                           f"{per_span[sub] * 1e3 / runner_ticks:8.3f} "
                           f"ms/runner-tick")
+    print(f"  {'(device drain)':20s} "
+          f"{drain * 1e3 / runner_ticks:8.3f} ms/runner-tick")
     print(f"  {'(unattributed host)':20s} "
-          f"{(wall - top_total) * 1e3 / runner_ticks:8.3f} ms/runner-tick")
+          f"{(wall - top_total - drain) * 1e3 / runner_ticks:8.3f} "
+          f"ms/runner-tick  (includes blocking waits inside spans' callees "
+          f"on CPU)")
     print(f"device trace written to {args.logdir} (view with xprof/"
           f"tensorboard)")
 
