@@ -16,6 +16,10 @@ import time
 
 sys.path.insert(0, ".")
 
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
 import numpy as np
 
 
