@@ -119,3 +119,36 @@ def test_simultaneous_dial_converges():
     finally:
         a.close()
         b.close()
+
+
+def test_framing_survives_arbitrary_fragmentation():
+    # datagrams must reassemble regardless of how TCP fragments the stream
+    import random
+
+    from bevy_ggrs_tpu.session.transport import _TcpConn
+
+    rng = random.Random(5)
+    msgs = [bytes([rng.randrange(256)]) * rng.randrange(1, 300)
+            for _ in range(200)]
+    stream = b"".join(
+        TcpNonBlockingSocket._frame(m, TcpNonBlockingSocket._DATA)
+        for m in msgs
+    )
+    sock_holder = TcpNonBlockingSocket(0, host="127.0.0.1")
+    conn = _TcpConn.__new__(_TcpConn)
+    conn.rbuf = bytearray()
+    got = []
+    i = 0
+    while i < len(stream):
+        n = rng.randrange(1, 97)  # arbitrary fragment sizes incl. tiny
+        conn.rbuf.extend(stream[i:i + n])
+        i += n
+        got.extend(p for t, p in sock_holder._pop_frames(conn.rbuf)
+                   if t == TcpNonBlockingSocket._DATA)
+    sock_holder.close()
+    assert got == msgs
+
+
+def test_oversized_datagram_rejected():
+    with pytest.raises(ValueError):
+        TcpNonBlockingSocket._frame(b"x" * (1 << 20))
