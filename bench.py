@@ -9,23 +9,33 @@ and checksum (what the driver actually dispatches on a rollback request).
 
 Baseline: the same semantics implemented as strong vectorized numpy on the
 host CPU — per frame: integrate, bounce, per-entity murmur-fold checksum,
-snapshot copy.  This is a *stronger* baseline than the reference's
-per-entity-HashMap data path (SURVEY §3.6), implemented in
-bench_baselines.py.  vs_baseline = device_fps / numpy_cpu_fps.
+snapshot copy (bench_baselines.py).  This is a *stronger* baseline than the
+reference's per-entity-HashMap data path (SURVEY §3.6).
+vs_baseline = device_fps / numpy_cpu_fps, with the exact denominator and the
+host it was measured on carried in the JSON (``baseline_host``).
+
+Crash-resilience (the round-3 lesson: a mid-suite tunnel death voided the
+round's TPU evidence): the suite is STAGED.  Each metric runs in its own
+subprocess with a timeout; every stage result is appended to
+``BENCH_PROGRESS.jsonl`` the moment it lands, so a later wedge cannot void
+earlier numbers.  Stages are ordered headline-first.  Between stages the
+orchestrator re-probes the backend (subprocess probe — a wedged tunnel hangs
+``jax.devices()`` indefinitely) and retries once after a cooldown before
+falling back to CPU for the REMAINING stages only; ``tpu_fallback_to_cpu``
+is true only if the HEADLINE stage itself ran on CPU.
 
 Rigor (criterion-equivalent, /root/reference/benches/bench.rs:47-95): every
 timed loop runs REPS times; the reported value is the MEDIAN and the spread
 (max-min)/median ships in the JSON so an unstable link shows up as a wide
 spread instead of a silently wrong point estimate.
 
-Speculation is reported as lane-0 USEFUL frames/s (one authoritative lane out
-of the 16-branch canonical dispatch); raw lane-frames/s (x16) is a secondary
-field.
-
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
+import argparse
 import json
+import os
+import platform as _platform
 import statistics
 import subprocess
 import sys
@@ -33,29 +43,36 @@ import time
 
 import numpy as np
 
+ROOT = os.path.dirname(os.path.abspath(__file__))
+PROGRESS_PATH = os.path.join(ROOT, "BENCH_PROGRESS.jsonl")
+
 N_ENTITIES = 10_000
-N_ENTITIES_BIG = 100_000
+N_BIG = 100_000
+N_HUGE = 1_000_000
 DEPTH = 8
 ITERS = 30
 REPS = 5
 SPEC_BRANCHES = 16
+LOBBIES = 16
 
 # v5e-class HBM bandwidth for the %BW context figure (the workload is
 # bandwidth-bound: elementwise integrate + hash, no matmuls -> MXU ~idle)
 HBM_BYTES_PER_SEC = 819e9
 
 
-def _device_backend_usable(timeout_s: int = 90) -> bool:
-    """Probe the default JAX backend in a subprocess (a wedged TPU tunnel can
-    hang jax.devices() indefinitely; don't let it take the benchmark down)."""
+def _host_tag() -> str:
+    """Machine identity for baseline provenance (VERDICT r3 'pin the
+    baselines': the numpy denominator varies 3x across hosts)."""
+    model = "?"
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True,
-        )
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return f"{_platform.node()}|{model}|ncpu={os.cpu_count()}"
 
 
 def _median_spread(samples):
@@ -64,26 +81,50 @@ def _median_spread(samples):
     return med, spread
 
 
-def _bench_layout(app, n_players=2):
-    """Median-of-REPS resim frames/s for one app; returns (median, spread)."""
+# --------------------------------------------------------------------------
+# stage bodies (run inside `bench.py --stage NAME` subprocesses)
+# --------------------------------------------------------------------------
+
+def _stage_setup():
+    """Per-stage jax setup: persistent compile cache (stages are separate
+    processes; without it each pays the full 20-40s TPU compile)."""
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(ROOT, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass  # cache is an optimization; never fail the stage over it
+    return jax
+
+
+def _bench_resim(app, n_players=2, iters=ITERS, reps=REPS, depth=DEPTH):
+    """Median-of-reps resim frames/s for one app; returns (median, spread).
+
+    Uses the DONATING dispatch (what the driver issues): the carried state's
+    buffers are reused in place by XLA, so each rep starts from a fresh
+    world (the previous rep's was consumed)."""
     import jax
     from bevy_ggrs_tpu.session.events import InputStatus
 
-    world = app.init_state()
+    fn = getattr(app, "resim_fn_donated", None) or app.resim_fn
     # host numpy inputs — what the driver actually passes per dispatch
-    inputs = np.zeros((DEPTH, n_players), np.uint8)
-    status = np.full((DEPTH, n_players), InputStatus.CONFIRMED, np.int8)
-    fn = app.resim_fn
-    final, stacked, checks = fn(world, inputs, status, 0)
+    inputs = np.zeros((depth, n_players), np.uint8)
+    status = np.full((depth, n_players), InputStatus.CONFIRMED, np.int8)
+    warm = app.init_state()
+    final, stacked, checks = fn(warm, inputs, status, 0)
     jax.block_until_ready((final, stacked, checks))
     samples = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        w = world
-        for i in range(ITERS):
-            w, stacked, checks = fn(w, inputs, status, i * DEPTH)
+    for _ in range(reps):
+        w = app.init_state()
         jax.block_until_ready(w)
-        samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+        t0 = time.perf_counter()
+        for i in range(iters):
+            w, stacked, checks = fn(w, inputs, status, i * depth)
+        jax.block_until_ready(w)
+        samples.append(depth * iters / (time.perf_counter() - t0))
     return _median_spread(samples)
 
 
@@ -92,45 +133,119 @@ def _state_bytes(app):
     import jax
 
     world = app.init_state()
-    return sum(
-        a.size * a.dtype.itemsize for a in jax.tree.leaves(world.comps)
-    )
+    return sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(world.comps))
 
 
-def bench_device():
-    import jax
+def _hbm_pct(fps, bytes_per_frame, plat):
+    if plat != "tpu":
+        return None
+    return round(100.0 * fps * bytes_per_frame / HBM_BYTES_PER_SEC, 2)
+
+
+def stage_resim10k():
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress_soa
+
+    app = stress_soa.make_app(N_ENTITIES)
+    fps, spread = _bench_resim(app)
+    plat = jax.devices()[0].platform
+    bpf = 3 * _state_bytes(app)  # step reads+writes + checksum re-read
+    return {
+        "fps_10k": round(fps, 1), "spread_10k": round(spread, 3),
+        "layout_10k": "scalar_columns",
+        "bytes_per_resim_frame": bpf,
+        "hbm_pct_10k": _hbm_pct(fps, bpf, plat),
+        "platform": plat,
+    }
+
+
+def stage_resim100k():
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress_soa
+
+    app = stress_soa.make_app(N_BIG, capacity=N_BIG)
+    fps, spread = _bench_resim(app, iters=10)
+    plat = jax.devices()[0].platform
+    bpf = 3 * _state_bytes(app)
+    return {
+        "fps_100k": round(fps, 1), "spread_100k": round(spread, 3),
+        "hbm_pct_100k": _hbm_pct(fps, bpf, plat), "platform": plat,
+    }
+
+
+def stage_resim1m():
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress_soa
+
+    app = stress_soa.make_app(N_HUGE, capacity=N_HUGE)
+    fps, spread = _bench_resim(app, iters=5, reps=3)
+    plat = jax.devices()[0].platform
+    bpf = 3 * _state_bytes(app)
+    return {
+        "fps_1m": round(fps, 1), "spread_1m": round(spread, 3),
+        "hbm_pct_1m": _hbm_pct(fps, bpf, plat), "platform": plat,
+    }
+
+
+def stage_batched():
+    """Many-worlds: M independent 10k-entity lobbies, one vmapped dispatch
+    (the server shape that supersedes the reference's one-session-per-process
+    model, /root/reference/src/lib.rs:79-88).  Reports aggregate lobby-frames
+    per second and the per-lobby rate."""
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress_soa
+    from bevy_ggrs_tpu.ops.batch import make_batched_resim_fn, stack_worlds
+    from bevy_ggrs_tpu.session.events import InputStatus
+
+    app = stress_soa.make_app(N_ENTITIES)
+    fn = make_batched_resim_fn(app)
+    worlds = stack_worlds([app.init_state() for _ in range(LOBBIES)])
+    inputs = np.zeros((LOBBIES, DEPTH, 2), np.uint8)
+    status = np.full((LOBBIES, DEPTH, 2), InputStatus.CONFIRMED, np.int8)
+    frames = np.zeros((LOBBIES,), np.int32)
+    out = fn(worlds, inputs, status, frames)
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        w = worlds
+        for i in range(ITERS):
+            w, stacked, checks = fn(w, inputs, status, frames + i * DEPTH)
+        jax.block_until_ready(w)
+        samples.append(LOBBIES * DEPTH * ITERS / (time.perf_counter() - t0))
+    agg, spread = _median_spread(samples)
+    plat = jax.devices()[0].platform
+    return {
+        "batched_lobbies": LOBBIES,
+        "batched_agg_fps_10k": round(agg, 1),
+        "batched_per_lobby_fps_10k": round(agg / LOBBIES, 1),
+        "batched_spread": round(spread, 3),
+        "platform": plat,
+    }
+
+
+def stage_canonical():
+    """Bit-determinism mode (fixed k=16 padded program) throughput."""
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress
+
+    app = stress.make_app(N_ENTITIES)
+    app.canonical_depth = 16
+    fps, spread = _bench_resim(app)
+    return {
+        "fps_canon": round(fps, 1), "spread_canon": round(spread, 3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def stage_speculation():
+    """BASELINE config 5: 4 players x 16 branches x 8 frames over the
+    10k-entity world via the canonical branched program.  Value = lane-0
+    USEFUL frames/s (one authoritative lane of the 16-branch dispatch)."""
+    jax = _stage_setup()
     import jax.numpy as jnp
-    from bevy_ggrs_tpu.models import stress, stress_soa
+    from bevy_ggrs_tpu.models import stress
 
-    # two layouts of the same workload: [N,3] matrices vs per-coordinate [N]
-    # scalar columns (lane-friendly on TPU, docs/tpu_notes.md §2)
-    fps_mat, spread_mat = _bench_layout(stress.make_app(N_ENTITIES))
-    fps_soa, spread_soa = _bench_layout(stress_soa.make_app(N_ENTITIES))
-    if fps_soa >= fps_mat:
-        fps, spread, layout = fps_soa, spread_soa, "scalar_columns"
-    else:
-        fps, spread, layout = fps_mat, spread_mat, "vec3_columns"
-
-    # game-scale secondary config
-    fps_big, spread_big = _bench_layout(
-        stress.make_app(N_ENTITIES_BIG, capacity=N_ENTITIES_BIG)
-    )
-
-    # bandwidth context: per resim frame the step reads+writes every column
-    # and the checksum re-reads them (~3 passes over the world).  Only
-    # meaningful against real TPU HBM — null on other platforms.
-    sb = _state_bytes(stress.make_app(N_ENTITIES))
-    bytes_per_frame = 3 * sb
-    platform = jax.devices()[0].platform
-    hbm_pct = (
-        100.0 * fps * bytes_per_frame / HBM_BYTES_PER_SEC
-        if platform == "tpu"
-        else None
-    )
-
-    # speculative fan-out (BASELINE config 5: 4 players x 16 branches x
-    # 8 frames over the 10k-entity world) via the CANONICAL branched program
-    # — the shipped bit-determinism + hedging dispatch shape
     app = stress.make_app(N_ENTITIES, num_players=4)
     app.canonical_depth = DEPTH
     app.canonical_branches = SPEC_BRANCHES
@@ -141,39 +256,56 @@ def bench_device():
     nr = jax.device_put(jnp.full((SPEC_BRANCHES,), DEPTH, jnp.int32))
     out = spec(world, bi, bs, 0, nr)
     jax.block_until_ready(out)
-    spec_samples = []
+    samples = []
     for _ in range(REPS):
         t0 = time.perf_counter()
         for i in range(ITERS):
             out = spec(world, bi, bs, i, nr)
         jax.block_until_ready(out)
-        spec_samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
-    spec_fps, spec_spread = _median_spread(spec_samples)  # lane-0 useful
-
-    # canonical bit-determinism mode (fixed k=16 program): the safe float
-    # configuration's throughput, reported alongside the fast path
-    capp = stress.make_app(N_ENTITIES)
-    capp.canonical_depth = 16
-    fps_canon, spread_canon = _bench_layout(capp)
-
+        samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+    fps, spread = _median_spread(samples)
     return {
-        "fps": fps, "spread": spread, "layout": layout,
-        "fps_mat": fps_mat, "fps_soa": fps_soa,
-        "fps_big": fps_big, "spread_big": spread_big,
-        "spec_fps": spec_fps, "spec_spread": spec_spread,
-        "fps_canon": fps_canon, "spread_canon": spread_canon,
-        "platform": platform, "hbm_pct": hbm_pct,
-        "bytes_per_frame": bytes_per_frame,
+        "spec_fps": round(fps, 1), "spec_spread": round(spread, 3),
+        "platform": jax.devices()[0].platform,
     }
 
 
-def bench_numpy_baseline(n_entities=N_ENTITIES, iters=ITERS):
+def stage_layouts():
+    """[N,3] matrix layout at 10k, for the layout-comparison field."""
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress
+
+    fps, spread = _bench_resim(stress.make_app(N_ENTITIES))
+    return {
+        "fps_vec3": round(fps, 1), "spread_vec3": round(spread, 3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+STAGES = {
+    # headline-first order — a tunnel death after stage k voids nothing
+    # before it (round-3 postmortem, VERDICT "what's weak" #1)
+    "resim10k": (stage_resim10k, 420),
+    "resim100k": (stage_resim100k, 420),
+    "resim1m": (stage_resim1m, 600),
+    "batched": (stage_batched, 600),
+    "canonical": (stage_canonical, 420),
+    "speculation": (stage_speculation, 420),
+    "layouts": (stage_layouts, 420),
+}
+
+
+# --------------------------------------------------------------------------
+# numpy baselines (orchestrator process; no device backend involved)
+# --------------------------------------------------------------------------
+
+def bench_numpy_baseline(n_entities, iters, reps=REPS):
     from bench_baselines import NumpyStressSim
 
     sim = NumpyStressSim(n_entities, seed=0)
     sim.resim(DEPTH)  # warmup
     samples = []
-    for _ in range(REPS):
+    for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(iters):
             sim.resim(DEPTH)
@@ -181,49 +313,189 @@ def bench_numpy_baseline(n_entities=N_ENTITIES, iters=ITERS):
     return _median_spread(samples)
 
 
-def main():
-    fallback = False
-    if not _device_backend_usable():
-        fallback = True
-        import jax
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
 
-        jax.config.update("jax_platforms", "cpu")
-    d = bench_device()
-    cpu_fps, cpu_spread = bench_numpy_baseline()
-    cpu_fps_big, _ = bench_numpy_baseline(N_ENTITIES_BIG, iters=5)
+def _append_progress(record: dict) -> None:
+    record = dict(record, ts=round(time.time(), 1))
+    with open(PROGRESS_PATH, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def _probe_backend(timeout_s: int = 120) -> bool:
+    """Probe the default JAX backend in a subprocess (a wedged TPU tunnel can
+    hang jax.devices() indefinitely; don't let it take the benchmark down)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, cwd=ROOT,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _run_stage(name: str, timeout_s: int, force_cpu: bool):
+    """Run one stage subprocess; returns (result_dict | None, error | None)."""
+    env = dict(os.environ)
+    if force_cpu:
+        env["BGT_PLATFORM"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--stage", name],
+            timeout=timeout_s, capture_output=True, text=True, cwd=ROOT,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if r.returncode != 0:
+        return None, (r.stderr or "nonzero-exit").strip()[-400:]
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1]), None
+    except (json.JSONDecodeError, IndexError):
+        return None, f"unparseable stage output: {r.stdout[-200:]!r}"
+
+
+def orchestrate():
+    _append_progress({"stage": "suite_start", "host": _host_tag()})
+    merged: dict = {}
+    stage_platforms: dict = {}
+    errors: dict = {}
+    force_cpu = False
+
+    if not _probe_backend():
+        print("initial backend probe failed; retrying in 45s", file=sys.stderr)
+        time.sleep(45)
+        if not _probe_backend():
+            force_cpu = True
+            _append_progress({"stage": "probe", "result": "dead->cpu"})
+
+    for name, (_, timeout_s) in STAGES.items():
+        if force_cpu and _probe_backend(60):
+            # the tunnel came back mid-suite: reclaim it for the rest
+            force_cpu = False
+            _append_progress({"stage": "probe", "result": "recovered->tpu"})
+        t0 = time.time()
+        result, err = _run_stage(name, timeout_s, force_cpu)
+        if result is None and not force_cpu:
+            # recovery path — distinguish "tunnel died" (finish remaining
+            # stages on CPU) from "this stage is broken on a healthy
+            # backend" (CPU-fallback THIS stage only, keep TPU for the rest)
+            print(f"stage {name} failed ({err}); probing tunnel",
+                  file=sys.stderr)
+            _append_progress({"stage": name, "error": err})
+            if _probe_backend():
+                result, err = _run_stage(name, timeout_s, force_cpu=False)
+            else:
+                time.sleep(45)
+                if _probe_backend():
+                    result, err = _run_stage(name, timeout_s, force_cpu=False)
+            if result is None:
+                if _probe_backend(60):
+                    _append_progress(
+                        {"stage": name, "note": "stage-only cpu fallback"}
+                    )
+                    result, err = _run_stage(name, timeout_s, force_cpu=True)
+                else:
+                    force_cpu = True
+                    _append_progress({"stage": "probe", "result": "dead->cpu"})
+                    result, err = _run_stage(name, timeout_s, force_cpu=True)
+        elif result is None and force_cpu:
+            _append_progress({"stage": name, "error": err})
+        if result is None:
+            errors[name] = err
+            continue
+        stage_platforms[name] = result.pop("platform", "cpu")
+        merged.update(result)
+        _append_progress({
+            "stage": name, "platform": stage_platforms[name],
+            "secs": round(time.time() - t0, 1), **result,
+        })
+        print(f"stage {name} [{stage_platforms[name]}] "
+              f"({time.time() - t0:.0f}s): {result}", file=sys.stderr)
+
+    # numpy baselines — host CPU, no tunnel exposure, machine-tagged
+    base10k, base10k_sp = bench_numpy_baseline(N_ENTITIES, iters=ITERS)
+    base100k, _ = bench_numpy_baseline(N_BIG, iters=5, reps=3)
+    base1m, _ = bench_numpy_baseline(N_HUGE, iters=1, reps=2)
+    _append_progress({
+        "stage": "baselines", "host": _host_tag(),
+        "numpy_fps_10k": round(base10k, 1),
+        "numpy_fps_100k": round(base100k, 1),
+        "numpy_fps_1m": round(base1m, 1),
+    })
+
+    fps10k = merged.get("fps_10k")
+    fpsvec3 = merged.get("fps_vec3")
+    if fps10k is not None and fpsvec3 is not None and fpsvec3 > fps10k:
+        value, spread, layout = fpsvec3, merged["spread_vec3"], "vec3_columns"
+    else:
+        value = fps10k
+        spread = merged.get("spread_10k")
+        layout = merged.get("layout_10k", "scalar_columns")
+
+    headline_platform = stage_platforms.get("resim10k", "none")
+    rnd = lambda x, n=1: (round(x, n) if x is not None else None)
+    div = lambda a, b: (round(a / b, 2) if a and b else None)
     result = {
         "metric": f"resim_frames_per_sec_{N_ENTITIES}ent_{DEPTH}frame_rollback",
-        "value": round(d["fps"], 1),
+        "value": rnd(value),
         "unit": "frames/s",
-        "vs_baseline": round(d["fps"] / cpu_fps, 2),
-        "spread": round(d["spread"], 3),
+        "vs_baseline": div(value, base10k),
+        "spread": rnd(spread, 3),
         "reps": REPS,
-        "baseline_numpy_cpu_fps": round(cpu_fps, 1),
-        "baseline_spread": round(cpu_spread, 3),
-        "resim_fps_100k_entities": round(d["fps_big"], 1),
-        "resim_fps_100k_spread": round(d["spread_big"], 3),
-        "vs_baseline_100k": round(d["fps_big"] / cpu_fps_big, 2),
-        "baseline_numpy_cpu_fps_100k": round(cpu_fps_big, 1),
-        "speculative_lane0_useful_fps": round(d["spec_fps"], 1),
-        "speculative_lane_frames_per_sec": round(
-            d["spec_fps"] * SPEC_BRANCHES, 1
-        ),
-        "speculative_spread": round(d["spec_spread"], 3),
-        "best_layout": d["layout"],
-        "vec3_layout_fps": round(d["fps_mat"], 1),
-        "scalar_columns_fps": round(d["fps_soa"], 1),
-        "canonical_mode_fps": round(d["fps_canon"], 1),
-        "canonical_spread": round(d["spread_canon"], 3),
-        "approx_hbm_bw_util_pct": (
-            round(d["hbm_pct"], 2) if d["hbm_pct"] is not None else None
-        ),
-        "bytes_per_resim_frame": d["bytes_per_frame"],
-        "platform": d["platform"],
+        "baseline_numpy_cpu_fps": round(base10k, 1),
+        "baseline_spread": round(base10k_sp, 3),
+        "baseline_host": _host_tag(),
+        "resim_fps_100k_entities": merged.get("fps_100k"),
+        "resim_fps_100k_spread": merged.get("spread_100k"),
+        "vs_baseline_100k": div(merged.get("fps_100k"), base100k),
+        "baseline_numpy_cpu_fps_100k": round(base100k, 1),
+        "resim_fps_1m_entities": merged.get("fps_1m"),
+        "resim_fps_1m_spread": merged.get("spread_1m"),
+        "vs_baseline_1m": div(merged.get("fps_1m"), base1m),
+        "baseline_numpy_cpu_fps_1m": round(base1m, 1),
+        "batched_lobbies": merged.get("batched_lobbies"),
+        "batched_agg_fps_10k": merged.get("batched_agg_fps_10k"),
+        "batched_per_lobby_fps_10k": merged.get("batched_per_lobby_fps_10k"),
+        "batched_agg_vs_baseline": div(merged.get("batched_agg_fps_10k"),
+                                       base10k),
+        "speculative_lane0_useful_fps": merged.get("spec_fps"),
+        "speculative_lane_frames_per_sec": rnd(
+            (merged.get("spec_fps") or 0) * SPEC_BRANCHES or None),
+        "speculative_spread": merged.get("spec_spread"),
+        "best_layout": layout,
+        "vec3_layout_fps": merged.get("fps_vec3"),
+        "scalar_columns_fps": merged.get("fps_10k"),
+        "canonical_mode_fps": merged.get("fps_canon"),
+        "canonical_spread": merged.get("spread_canon"),
+        "approx_hbm_bw_util_pct": merged.get("hbm_pct_10k"),
+        "approx_hbm_bw_util_pct_100k": merged.get("hbm_pct_100k"),
+        "approx_hbm_bw_util_pct_1m": merged.get("hbm_pct_1m"),
+        "bytes_per_resim_frame": merged.get("bytes_per_resim_frame"),
+        "platform": headline_platform,
+        "stage_platforms": stage_platforms,
+        "stage_errors": errors or None,
         "entities": N_ENTITIES,
         "rollback_depth": DEPTH,
-        "tpu_fallback_to_cpu": fallback,
+        "tpu_fallback_to_cpu": headline_platform != "tpu",
     }
+    _append_progress({"stage": "suite_done", **result})
     print(json.dumps(result))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", choices=sorted(STAGES), default=None)
+    args = ap.parse_args()
+    if args.stage:
+        from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+        apply_platform_env()
+        print(json.dumps(STAGES[args.stage][0]()))
+        return
+    orchestrate()
 
 
 if __name__ == "__main__":

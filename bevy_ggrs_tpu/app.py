@@ -171,7 +171,8 @@ class App:
         return self._step
 
     def _invalidate(self):
-        for k in ("advance_fn", "resim_fn", "speculate_fn", "checksum_fn"):
+        for k in ("advance_fn", "resim_fn", "resim_fn_donated",
+                  "speculate_fn", "checksum_fn", "branched_fn"):
             self.__dict__.pop(k, None)
 
     @cached_property
@@ -218,6 +219,28 @@ class App:
                 self.canonical_depth,
             )
         return make_resim_fn(self.reg, self.step, self.fps, self.seed, self.retention)
+
+    @cached_property
+    def resim_fn_donated(self):
+        """Donating variant of :attr:`resim_fn` — the input state's buffers
+        are handed to XLA for in-place reuse and the passed state object is
+        DEAD after the call.  Callers must prove nothing else references the
+        state (the driver tracks this; see GgrsRunner._run_batch).
+
+        ``None`` in BOTH canonical modes: ``jit(donate_argnums=...)`` is a
+        DIFFERENT compiled executable than the plain one, and canonical mode
+        exists precisely because two compiles of the same step may round
+        differently (ops/resim.resim_padded docstring) — a driver that
+        alternates donated/non-donated dispatches by runtime donatability
+        would reintroduce the program-variant drift canonical mode removes.
+        Donation is a fast-path for the default (per-length-program) mode
+        only."""
+        if self.canonical_branches is not None or self.canonical_depth is not None:
+            return None
+        return make_resim_fn(
+            self.reg, self.step, self.fps, self.seed, self.retention,
+            donate=True,
+        )
 
     def _branched_resim_wrapper(self):
         """resim_fn facade over the branched program: lane 0 carries the real

@@ -218,16 +218,23 @@ def trim_frames(tree, k: int, axis: int = 0):
 
 def make_canonical_resim_fn(reg: Registry, step_fn: StepFn, fps: int,
                             seed: int = 0, retention: int = 16,
-                            k_max: int = 16):
+                            k_max: int = 16, donate: bool = False):
     """jit of :func:`resim_padded` — ONE compiled program for every advance,
-    wrapped to the plain resim_fn signature (pads, dispatches, trims)."""
+    wrapped to the plain resim_fn signature (pads, dispatches, trims).
 
-    @jax.jit
-    def fn(state, inputs_seq, status_seq, start_frame, n_real):
+    ``donate=True`` donates the input state's buffers to XLA (the caller's
+    state object is DEAD after the call — the driver only uses this when it
+    can prove nothing else aliases the state; see GgrsRunner donation notes).
+    Donation lets XLA write the scan carry in place instead of allocating a
+    fresh world every dispatch."""
+
+    def body(state, inputs_seq, status_seq, start_frame, n_real):
         return resim_padded(
             reg, step_fn, state, inputs_seq, status_seq, start_frame, n_real,
             retention, fps, seed,
         )
+
+    fn = jax.jit(body, donate_argnums=(0,) if donate else ())
 
     def wrapped(state, inputs_seq, status_seq, start_frame, _unused=None):
         k = inputs_seq.shape[0]
@@ -285,17 +292,20 @@ def make_advance_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
 
 
 def make_resim_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
-                  retention: int = 16):
-    """jit-compiled k-frame resim (one compile per distinct k)."""
+                  retention: int = 16, donate: bool = False):
+    """jit-compiled k-frame resim (one compile per distinct k).
 
-    @jax.jit
-    def fn(state, inputs_seq, status_seq, start_frame, _retire_unused=None):
+    ``donate=True`` donates the input state (see
+    :func:`make_canonical_resim_fn`): the passed state object is dead after
+    the call; XLA may reuse its buffers for the outputs."""
+
+    def body(state, inputs_seq, status_seq, start_frame, _retire_unused=None):
         return resim(
             reg, step_fn, state, inputs_seq, status_seq, start_frame, retention,
             fps, seed
         )
 
-    return fn
+    return jax.jit(body, donate_argnums=(0,) if donate else ())
 
 
 def make_speculate_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,

@@ -93,8 +93,45 @@ class GgrsRunner:
         self.rollbacks = 0
         self.rollback_frames = 0  # total resimulated frames
         self.device_dispatches = 0
+        # HBM guard for lazy ring saves: storing LazySlice handles keeps the
+        # whole [k, ...] stacked resim buffer alive while ANY of its frames
+        # is ringed — O(ring_depth x k) world copies worst case.  Above this
+        # per-dispatch stacked-buffer size the driver materializes each save
+        # (one extra device-side copy per frame, no host transfer), bounding
+        # ring memory to O(ring_depth) worlds.  Small worlds keep the lazy
+        # handles (the per-slice dispatch is the cost that matters there).
+        self.ring_materialize_bytes = 64 * 2**20
+        # Buffer donation: when provably safe, the dispatch donates the input
+        # state's buffers so XLA reuses them in place instead of allocating a
+        # fresh world per dispatch (round-1 NOTES gap #4).  Safe = the state
+        # object is referenced ONLY by self.world: False at init (the caller
+        # may hold the initial state) and after any event that aliases the
+        # world with the ring; True after every dispatch/rollback that leaves
+        # self.world holding a freshly materialized buffer.  Speculation
+        # paths retain the pre-state after dispatch, so donation stays off
+        # whenever a SpeculationCache is attached.
+        self.enable_donation = True
+        self._world_donatable = False
+        self._last_stacked = None  # previous dispatch's stacked saves
+        self._last_k = 0
+        self._last_stacked_frame: Optional[int] = None
         if session is not None:
             self.set_session(session)
+
+    # -- live world access ---------------------------------------------------
+
+    @property
+    def world(self):
+        """The live WorldState.  Assigning to it (the supported
+        external-write pattern, e.g. desync-injection tests) marks the
+        state non-donatable: the caller may still hold references to the
+        buffers, so the next dispatch must not hand them to XLA."""
+        return self._world
+
+    @world.setter
+    def world(self, value) -> None:
+        self._world = value
+        self._world_donatable = False
 
     # -- session lifecycle (restart semantics, schedule_systems.rs:70-79) ---
 
@@ -113,6 +150,8 @@ class GgrsRunner:
         self.frame = 0
         self.confirmed = NULL_FRAME
         self.ring.clear()
+        self._last_stacked = None
+        self._last_stacked_frame = None
         if session is not None:
             # despawn-retirement safety invariant (ops/resim.py docstring):
             # slots hard-freed at frame-retention must never sit inside the
@@ -347,9 +386,17 @@ class GgrsRunner:
         self.rollbacks += 1
         with span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
+            was_lazy = isinstance(stored, LazySlice)
             self.world = self.app.reg.load_state(materialize(stored))
             self._world_checksum = checksum
             self.frame = frame
+        # LazySlice materialization / non-identity decode produce fresh
+        # buffers; a materialized identity snapshot IS the ring's object
+        self._world_donatable = (
+            was_lazy or not self.app.reg.is_identity_strategy()
+        )
+        self._last_stacked = None
+        self._last_stacked_frame = None
         if self.spec_cache is not None:
             # branches hedged from now-superseded predicted states must not
             # serve future lookups (see SpeculationCache.invalidate_after)
@@ -399,6 +446,37 @@ class GgrsRunner:
         use_branched = (
             self.spec_cache is not None and self.app.canonical_branches is not None
         )
+        # Donation decision + pre-resolution of leading (c==0) saves.  A
+        # leading save stores the PRE-dispatch state; donation kills that
+        # buffer, so it must be serviceable without pre_world: identity
+        # strategies slice it out of the PREVIOUS dispatch's stacked saves
+        # (bit-identical: final == stacked[-1]); lossy strategies encode it
+        # before the dispatch runs.
+        leading_saves = []
+        for r in run:
+            if isinstance(r, AdvanceRequest):
+                break
+            if isinstance(r, SaveRequest):
+                leading_saves.append(r)
+        c0_stored = None
+        donate = (
+            self.enable_donation
+            and self.spec_cache is None
+            and self._world_donatable
+            and k - skip > 0
+            and not use_branched
+            and self.app.resim_fn_donated is not None
+        )
+        if donate and leading_saves:
+            if identity:
+                if self._last_stacked is not None and all(
+                    r.frame == self._last_stacked_frame for r in leading_saves
+                ):
+                    c0_stored = LazySlice(self._last_stacked, self._last_k - 1)
+                else:
+                    donate = False  # must ring pre_world itself
+            else:
+                c0_stored = self.app.reg.store_state(materialize(pre_world))
         if k - skip > 0:
             self.device_dispatches += 1
             self.rollback_frames += max(k - skip - 1, 0)
@@ -410,7 +488,11 @@ class GgrsRunner:
                         inputs, status, adv[-1]
                     )
                 else:
-                    final, stacked, checks = self.app.resim_fn(
+                    fn = (
+                        self.app.resim_fn_donated if donate
+                        else self.app.resim_fn
+                    )
+                    final, stacked, checks = fn(
                         self.world, inputs, status, self.frame
                     )
                 batch_checks = BatchChecks(checks)
@@ -419,6 +501,19 @@ class GgrsRunner:
                 self.world = final
                 self._world_checksum = batch_checks.ref(k - skip - 1)
                 self.frame = frame_add(self.frame, k - skip)
+                self._last_stacked = stacked
+                self._last_k = k - skip
+                self._last_stacked_frame = self.frame
+                self._world_donatable = True  # final is a fresh buffer
+        materialize_saves = False
+        if stacked is not None:
+            import jax as _jax
+
+            stacked_bytes = sum(
+                a.size * a.dtype.itemsize for a in _jax.tree.leaves(stacked)
+            )
+            materialize_saves = stacked_bytes > self.ring_materialize_bytes
+        pushed_pre_world = False
         with span("SaveWorld"):
             c = 0  # advances seen so far within the run
             for r in run:
@@ -426,13 +521,24 @@ class GgrsRunner:
                     c += 1
                     continue
                 if c == 0:
+                    if c0_stored is not None:
+                        # pre-resolved (donation path): pre_world's buffers
+                        # may already be dead — serve from the previous
+                        # dispatch's stacked saves / the pre-encoded store
+                        self.ring.push(r.frame, (c0_stored, pre_checksum))
+                        r.cell.save(r.frame, pre_checksum.to_int)
+                        continue
                     state_s, cs = pre_world, pre_checksum
+                    pushed_pre_world = identity
                 elif c <= skip:
                     state_s, cs = cache_states(c - 1), cache_bc.ref(c - 1)
                 else:
                     # defer the per-frame slice: the ring stores a handle into
                     # the stacked buffer; slicing dispatches only on rollback
+                    # (or eagerly for big worlds — see ring_materialize_bytes)
                     state_s = LazySlice(stacked, c - 1 - skip)
+                    if materialize_saves:
+                        state_s = state_s.materialize()
                     cs = batch_checks.ref(c - 1 - skip)
                 stored = (
                     state_s
@@ -441,6 +547,22 @@ class GgrsRunner:
                 )
                 self.ring.push(r.frame, (stored, cs))
                 r.cell.save(r.frame, cs.to_int)
+        if pushed_pre_world and self._world is pre_world:
+            # save-only run (or full cache skip): the ring now aliases the
+            # live world object; the next dispatch must not donate it
+            self._world_donatable = False
+        if (
+            materialize_saves
+            or self.spec_cache is not None
+            or not self.enable_donation
+            or not identity
+        ):
+            # retaining the stacked buffer only pays off when the NEXT
+            # dispatch's leading save can be served from it (identity +
+            # donation); otherwise it would just pin k extra world copies
+            # in device memory — exactly what ring_materialize_bytes bounds
+            self._last_stacked = None
+            self._last_stacked_frame = None
         # hedge the live frame: if its inputs were (partly) predicted, fan out
         # candidate branches for the same transition (the branched program
         # already did this inside its own dispatch)

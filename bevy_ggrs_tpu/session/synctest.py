@@ -53,11 +53,16 @@ class SyncTestSession:
         # 32 on accelerator backends.
         self._compare_interval = compare_interval
         self._ticks_since_compare = 0
+        self._compares_run = 0  # see __del__ silent-oracle guard
         # frame -> [P, *shape] effective (post-delay) confirmed inputs
         self._inputs: Dict[int, np.ndarray] = {}
         self._staged: Dict[int, np.ndarray] = {}
         # frame -> list of (checksum provider | forced int)
         self._cells: Dict[int, List] = {}
+        # frame -> entry count at last comparison (cells stay in _cells
+        # after comparing — later resim saves must compare against history —
+        # so pending_comparisons needs a watermark to tell compared apart)
+        self._compared_len: Dict[int, int] = {}
 
     # -- GGRS session surface ---------------------------------------------
 
@@ -149,6 +154,38 @@ class SyncTestSession:
         self._ticks_since_compare = 0
         self._check_mismatches()
 
+    def pending_comparisons(self) -> int:
+        """Frames with ≥2 saved checksums of which at least one arrived
+        after the frame's last comparison (a nonzero value at teardown means
+        the oracle has unchecked data — call :meth:`check_now` /
+        ``runner.finish()``)."""
+        return sum(
+            1
+            for f, entries in self._cells.items()
+            if len(entries) >= 2
+            and self._compared_len.get(f, 0) < len(entries)
+        )
+
+    def __del__(self):
+        # Deferred comparison (compare_interval > 1, the accelerator default)
+        # must not let a short run exit with the oracle silently unexercised:
+        # a SyncTest that never compared anything proves nothing.
+        try:
+            if self._compares_run == 0 and self.pending_comparisons() > 0:
+                import warnings
+
+                warnings.warn(
+                    "SyncTestSession dropped with NO checksum comparisons "
+                    f"ever performed ({self.pending_comparisons()} frames "
+                    "pending) — the determinism oracle never ran; call "
+                    "runner.finish() or session.check_now() before teardown "
+                    f"(compare_interval={self._compare_interval})",
+                    RuntimeWarning,
+                    stacklevel=1,
+                )
+        except Exception:
+            pass  # interpreter teardown: modules may already be gone
+
     # -- internals ---------------------------------------------------------
 
     def _input_for(self, frame: int) -> np.ndarray:
@@ -163,6 +200,10 @@ class SyncTestSession:
         for frame, entries in self._cells.items():
             if len(entries) < 2:
                 continue
+            # only a frame with >=2 checksums is a real comparison — a
+            # vacuous sweep must not satisfy the __del__ silent-oracle guard
+            self._compares_run += 1
+            self._compared_len[frame] = len(entries)
             vals = set()
             for i, e in enumerate(entries):
                 v = e() if callable(e) else e
@@ -175,6 +216,7 @@ class SyncTestSession:
             frames = sorted(mismatched)
             for fr in frames:
                 del self._cells[fr]
+                self._compared_len.pop(fr, None)
             raise MismatchedChecksumError(self.current_frame, frames)
 
     def _gc(self) -> None:
@@ -187,6 +229,7 @@ class SyncTestSession:
         )
         for fr in [fr for fr in self._cells if frame_diff(fr, cell_horizon) < 0]:
             del self._cells[fr]
+            self._compared_len.pop(fr, None)
         horizon = frame_add(self.current_frame, -self.check_distance - 2)
         for fr in [fr for fr in self._inputs if frame_diff(fr, horizon) < 0]:
             del self._inputs[fr]

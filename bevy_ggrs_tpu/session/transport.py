@@ -132,7 +132,17 @@ class TcpNonBlockingSocket:
     tolerates (it is loss-tolerant, not loss-requiring); head-of-line
     blocking makes it a worse *competitive* transport than UDP — same
     trade-off the reference accepts for WebRTC data channels in reliable
-    mode."""
+    mode.
+
+    Peer identity: an inbound connection is keyed by the IP observed on the
+    wire (``getpeername``) + the listener port announced in the peer's hello
+    frame, so NATed dialers are keyed by their routable return address (the
+    one this side's address book dials), not their self-reported private IP.
+    Caveat for multi-homed hosts: if the peer's return route uses a
+    different interface than the address you dial it at, the keys can still
+    disagree — bind each listener to a specific interface (not 0.0.0.0) in
+    multi-homed deployments so the simultaneous-dial tie-break is computed
+    on the same key by both sides."""
 
     _MAX_FRAME = 1 << 20
     _DATA = 0x00
@@ -276,8 +286,21 @@ class TcpNonBlockingSocket:
             if ftype != self._HELLO or len(payload) != 6:
                 conn.close()  # protocol violation: first frame must be hello
                 continue
-            peer = (socket.inet_ntoa(payload[:4]),
-                    int.from_bytes(payload[4:6], "big"))
+            # Key the conn by the peer IP OBSERVED on the wire (getpeername)
+            # plus the hello's listener port.  The self-reported hello IP is
+            # the kernel-chosen source IP of the dialer's socket, which on
+            # NATed hosts is a private address the acceptor cannot dial —
+            # the observed address is the routable return path and matches
+            # the address book the session dials.  Self-report is only the
+            # fallback when the socket cannot name its peer.
+            hello_ip = socket.inet_ntoa(payload[:4])
+            try:
+                observed_ip = conn.sock.getpeername()[0]
+            except OSError:
+                observed_ip = hello_ip
+            if observed_ip in ("", "0.0.0.0"):
+                observed_ip = hello_ip
+            peer = (observed_ip, int.from_bytes(payload[4:6], "big"))
             data = [p for t, p in frames[1:] if t == self._DATA]
             if peer in self._conns:
                 # simultaneous dial: the connection initiated by the LOWER

@@ -51,6 +51,12 @@ class BatchChecks:
         """uint64[k, 2] host copy; first call pulls every pending batch."""
         if self._host is None:
             BatchChecks.pull_pending()
+        if self._host is None:  # defensive: a failed pull leaves us pending
+            raise RuntimeError(
+                "checksum batch was never pulled (a prior device->host "
+                "transfer failed); retry pull_pending() once the backend "
+                "is reachable"
+            )
         return self._host
 
     def ref(self, i: int) -> "ChecksumRef":
@@ -67,13 +73,18 @@ class BatchChecks:
         import jax
 
         pending = [b for b in cls._pending if b._host is None]
-        cls._pending.clear()
         if not pending:
+            cls._pending.clear()
             return
+        # NOTE: batches leave the pending set only AFTER the pull succeeds —
+        # if the device_get raises (flaky tunnel), every batch stays pending
+        # and the next pull retries, instead of orphaning them with
+        # _host=None and masking the device error with a TypeError later.
         if len(pending) == 1:
             pending[0]._host = np.asarray(
                 jax.device_get(pending[0]._dev), dtype=np.uint64
             )
+            cls._pending.clear()
             return
         fused = _concat_rows(*[b._dev for b in pending])
         host = np.asarray(jax.device_get(fused), dtype=np.uint64)
@@ -82,6 +93,7 @@ class BatchChecks:
             k = b._dev.shape[0]
             b._host = host[off:off + k]
             off += k
+        cls._pending.clear()
 
 
 def _concat_rows(*xs):
