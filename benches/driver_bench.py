@@ -107,12 +107,184 @@ def bench_p2p_channel(n_entities=2000, ticks=300):
     }))
 
 
+def bench_batched_lobbies(m=16, n_entities=2000, ticks=60, check_distance=3):
+    """Many-worlds server: M synctest lobbies through ONE BatchedRunner vs
+    M serial GgrsRunners.  Metric = aggregate lobby-ticks/s (every lobby
+    advances one frame per server tick).  The batched driver issues ~2
+    dispatches per server tick regardless of M; the serial baseline issues
+    ~2M — the submission-amortization the reference's one-session-per-
+    process model (/root/reference/src/lib.rs:79-88) cannot express."""
+    import numpy as np
+
+    from bevy_ggrs_tpu import BatchedRunner, GgrsRunner, SyncTestSession
+    from bevy_ggrs_tpu.models import stress
+
+    def session():
+        return SyncTestSession(num_players=2, input_shape=(),
+                               input_dtype=np.uint8,
+                               check_distance=check_distance)
+
+    def read_b(lobby, handles):
+        return {h: np.uint8((lobby * 5 + h) & 0xF) for h in handles}
+
+    app = stress.make_app(n_entities, capacity=n_entities)
+    br = BatchedRunner(app, [session() for _ in range(m)],
+                       read_inputs=read_b)
+    warm = check_distance + 34
+    for _ in range(warm):
+        br.tick()
+
+    def run_batched(n):
+        for _ in range(n):
+            br.tick()
+
+    med_b, spread_b = _timed_passes(run_batched, ticks)
+    br.finish()
+
+    serial = [
+        GgrsRunner(
+            stress.make_app(n_entities, capacity=n_entities),
+            session(),
+            read_inputs=lambda hs, b=b: read_b(b, hs),
+        )
+        for b in range(m)
+    ]
+    for _ in range(warm):
+        for r in serial:
+            r.tick()
+
+    def run_serial(n):
+        for _ in range(n):
+            for r in serial:
+                r.tick()
+
+    med_s, spread_s = _timed_passes(run_serial, ticks)
+    for r in serial:
+        r.finish()
+    print(json.dumps({
+        "metric": f"batched_lobbies_{m}x{n_entities}ent_lobby_ticks_per_sec",
+        "value": round(med_b * m, 1), "unit": "lobby-ticks/s",
+        "spread": round(spread_b, 3),
+        "serial_lobby_ticks_per_sec": round(med_s * m, 1),
+        "serial_spread": round(spread_s, 3),
+        "batched_vs_serial": round(med_b / med_s, 2) if med_s else None,
+        "lobbies": m, "passes": PASSES,
+    }))
+
+
+def bench_speculation_payoff(n_entities=2000, ticks=240):
+    """Does speculation pay under jitter?  2-peer box_game-shaped pad over a
+    lossy/jittery channel (BASELINE config 5 territory), three driver
+    configurations: speculation off / on (per-length programs) / canonical-
+    branched (the bit-determinism + hedging shape).  Reports ticks/s plus
+    rollback + hit-rate counters so break-even is visible either way."""
+    import numpy as np
+
+    from bevy_ggrs_tpu import (
+        GgrsRunner,
+        PlayerType,
+        SessionBuilder,
+        SessionState,
+        SpeculationConfig,
+    )
+    from bevy_ggrs_tpu.models import stress
+    from bevy_ggrs_tpu.ops.speculation import pad_candidates
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    def make_pair(mode):
+        net = ChannelNetwork(latency_hops=2, jitter_hops=3, loss=0.05, seed=9)
+        runners = []
+        for i in range(2):
+            if mode == "canonical_branched":
+                app = stress.make_app(n_entities, capacity=n_entities)
+                app.canonical_depth = 16
+                app.canonical_branches = 17  # lane 0 + 16 pad hedges
+            else:
+                app = stress.make_app(n_entities, capacity=n_entities)
+            b = (SessionBuilder(input_shape=(), input_dtype=np.uint8)
+                 .with_num_players(2).with_input_delay(1)
+                 .with_max_prediction_window(8)
+                 .with_disconnect_timeout(60.0)
+                 .with_disconnect_notify_delay(30.0)
+                 .add_player(PlayerType.LOCAL, i)
+                 .add_player(PlayerType.REMOTE, 1 - i,
+                             "b" if i == 0 else "a"))
+            sess = b.start_p2p_session(net.endpoint("a" if i == 0 else "b"))
+            spec = None
+            if mode in ("on", "canonical_branched"):
+                spec = SpeculationConfig(
+                    candidates_fn=pad_candidates(2, [1 - i], range(16)),
+                    depth=4,
+                )
+            rng = np.random.default_rng(21 + i)
+            runners.append(GgrsRunner(
+                app, sess,
+                read_inputs=lambda hs, r=rng: {
+                    h: np.uint8(r.integers(0, 16)) for h in hs
+                },
+                speculation=spec,
+            ))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            net.deliver()
+            for r in runners:
+                r.update(0.0)
+            if all(r.session.current_state() == SessionState.RUNNING
+                   for r in runners):
+                break
+            time.sleep(0.001)
+        for _ in range(40):  # warmup/compile
+            net.deliver()
+            for r in runners:
+                r.update(1 / 60)
+        return net, runners
+
+    for mode in ("off", "on", "canonical_branched"):
+        net, runners = make_pair(mode)
+
+        def run(n):
+            for _ in range(n):
+                net.deliver()
+                for r in runners:
+                    r.update(1 / 60)
+
+        med, spread = _timed_passes(run, ticks)
+        s = runners[0].stats()
+        print(json.dumps({
+            "metric": f"speculation_payoff_{mode}_ticks_per_sec_{n_entities}ent",
+            "value": round(med, 1), "unit": "ticks/s",
+            "spread": round(spread, 3), "passes": PASSES,
+            "rollbacks": s["rollbacks"],
+            "resimulated_frames": s["resimulated_frames"],
+            "speculation_hits": s["speculation_hits"],
+            "speculation_misses": s["speculation_misses"],
+            "dispatches": s["device_dispatches"],
+        }))
+
+
 if __name__ == "__main__":
+    import argparse
+
     import jax
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--speculation-payoff", action="store_true",
+                    help="run only the speculation payoff matrix")
+    ap.add_argument("--batched-only", action="store_true",
+                    help="run only the batched-lobbies comparison")
+    args = ap.parse_args()
 
     print(json.dumps({"metric": "platform",
                       "value": jax.devices()[0].platform, "unit": ""}))
-    bench_synctest()
-    bench_synctest(n_entities=100_000, ticks=100)
-    bench_p2p_channel()
-    bench_p2p_channel(n_entities=100_000, ticks=200)
+    if args.speculation_payoff:
+        bench_speculation_payoff()
+    elif args.batched_only:
+        bench_batched_lobbies(m=16, n_entities=2000)
+        bench_batched_lobbies(m=16, n_entities=10_000, ticks=30)
+    else:
+        bench_synctest()
+        bench_synctest(n_entities=100_000, ticks=100)
+        bench_p2p_channel()
+        bench_p2p_channel(n_entities=100_000, ticks=200)
+        bench_batched_lobbies(m=16, n_entities=2000)
+        bench_batched_lobbies(m=16, n_entities=10_000, ticks=30)

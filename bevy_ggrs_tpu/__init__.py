@@ -12,6 +12,7 @@ a native C++ core.
 
 from .app import App, DEFAULT_FPS
 from .runner import GgrsRunner
+from .batch_runner import BatchedRunner
 from .ops.resim import StepCtx, select_branch, slice_frame
 from .ops.speculation import SpeculationConfig, SpeculationCache, pad_candidates
 from .ops.variant_probe import probe_program_variants, VariantProbeReport

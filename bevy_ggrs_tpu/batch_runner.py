@@ -1,0 +1,408 @@
+"""BatchedRunner — the many-worlds game server driver.
+
+The reference runs ONE session per process (`Session` is a singleton Bevy
+resource, /root/reference/src/lib.rs:79-88); a server hosting M lobbies runs
+M processes, each dispatching its own tiny sim.  A TPU inverts the economics:
+one chip eats hundreds of small worlds per pass, and on remote-attached
+devices the per-dispatch submission cost dominates small worlds — so M
+serial dispatches are the one thing the server must not do.
+
+This driver owns M sessions (any mix of SyncTest / P2P / in-process — they
+only need the GgrsRequest protocol) over ONE resident ``[M, ...]`` stacked
+world.  Each server tick it:
+
+1. polls every session and collects its request list (host-side, cheap);
+2. splits each lobby's list into an ordered sequence of ops —
+   ``Load(frame)`` / ``Run([Save|Advance ...])`` — exactly the segments
+   GgrsRunner fuses per lobby (runner.py _handle_requests);
+3. executes ops positionally as WAVES across lobbies: wave w batches every
+   lobby's w-th Run into ONE ``jit(vmap(resim_padded))`` dispatch
+   (per-lobby ``n_real`` masks; idle lanes pass through), and serves Load
+   ops host-side from per-lobby snapshot rings (with a fused gather path
+   when every lobby loads out of the SAME past dispatch's stacked buffer —
+   the lockstep-SyncTest shape).
+
+Saves store ``LazySlice(stacked, (lobby, frame_idx))`` handles — one
+``[M, K, ...]`` buffer per wave backs every lobby's ring rows, and checksum
+pulls ride the process-wide BatchChecks fusion (snapshot/lazy.py).
+
+Bit-equality caveat (same as ops/batch.py): the vmapped program is a
+DIFFERENT XLA program than the single-lobby one, so for variant-unstable
+float sims a batched lobby is not guaranteed bit-identical to a solo run of
+the same inputs; integer/fixed-point sims and variant-stable steps (probe
+with ops/variant_probe.py) batch exactly — proven by
+tests/test_batched_runner.py against M independent GgrsRunners.  Canonical
+modes are refused for the same reason (make_batched_resim_fn docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .app import App
+from .ops.batch import make_batched_padded_fn, stack_worlds
+from .ops.resim import pad_repeat_last
+from .session.events import (
+    MismatchedChecksumError,
+    NotSynchronizedError,
+    PredictionThresholdError,
+    SessionState,
+)
+from .session.requests import AdvanceRequest, GgrsRequest, LoadRequest, SaveRequest
+from .session.synctest import SyncTestSession
+from .snapshot.lazy import BatchChecks, LazySlice, materialize
+from .snapshot.ring import SnapshotRing
+from .utils.frames import NULL_FRAME, frame_add
+from .utils.tracing import span
+
+
+class _Op:
+    __slots__ = ("load_frame", "run")
+
+    def __init__(self, load_frame=None, run=None):
+        self.load_frame = load_frame  # int | None
+        self.run = run  # List[GgrsRequest] | None
+
+
+def _split_ops(requests: List[GgrsRequest]) -> List[_Op]:
+    """[Load?](Advance|Save)* request list -> ordered Load/Run ops
+    (the same maximal-run fusion as GgrsRunner._handle_requests)."""
+    ops: List[_Op] = []
+    i, n = 0, len(requests)
+    while i < n:
+        r = requests[i]
+        if isinstance(r, LoadRequest):
+            ops.append(_Op(load_frame=r.frame))
+            i += 1
+        else:
+            j = i
+            while j < n and isinstance(requests[j], (AdvanceRequest, SaveRequest)):
+                j += 1
+            ops.append(_Op(run=requests[i:j]))
+            i = j
+    return ops
+
+
+class BatchedRunner:
+    """M lobbies, one fused device dispatch per wave (module docstring)."""
+
+    def __init__(
+        self,
+        app: App,
+        sessions: Sequence,
+        read_inputs: Optional[Callable[[int, List[int]], Dict[int, np.ndarray]]] = None,
+        on_mismatch: Optional[Callable[[int, MismatchedChecksumError], None]] = None,
+        on_event: Optional[Callable[[int, object], None]] = None,
+        k_max: Optional[int] = None,
+    ):
+        if app.canonical_depth is not None or app.canonical_branches is not None:
+            raise ValueError(
+                "BatchedRunner is incompatible with canonical mode "
+                "(see ops/batch.make_batched_resim_fn)"
+            )
+        self.app = app
+        self.sessions = list(sessions)
+        m = len(self.sessions)
+        if m == 0:
+            raise ValueError("BatchedRunner needs at least one session")
+        # the deepest run any session can emit in one tick: a rollback spans
+        # the full window plus the live advance
+        windows = []
+        for s in self.sessions:
+            w = (
+                s.rollback_window()
+                if hasattr(s, "rollback_window")
+                else s.max_prediction()
+            )
+            windows.append(max(w, s.max_prediction()))
+            if self.app.retention < w:
+                raise ValueError(
+                    f"App(retention={self.app.retention}) < session rollback "
+                    f"window ({w}) — see GgrsRunner.set_session"
+                )
+        self.k_max = k_max if k_max is not None else max(windows) + 1
+        self.read_inputs = read_inputs or (
+            lambda lobby, handles: {h: app.zero_inputs()[h] for h in handles}
+        )
+        self.on_mismatch = on_mismatch
+        self.on_event = on_event
+        self.worlds = stack_worlds([app.init_state() for _ in range(m)])
+        self.fn = make_batched_padded_fn(app, self.k_max)
+        # per-lobby live-world checksum handles (ONE vmapped dispatch for
+        # all M rows; leading saves reuse these instead of dispatching)
+        import jax as _jax
+
+        from .snapshot.checksum import world_checksum as _wc
+
+        self._batch_checksum_fn = _jax.jit(
+            lambda ws: _jax.vmap(lambda w: _wc(app.reg, w))(ws)
+        )
+        init_batch = BatchChecks(self._batch_checksum_fn(self.worlds))
+        self._world_checksum = [init_batch.ref(b) for b in range(m)]
+        self.rings = [SnapshotRing(depth=max(windows) + 2) for _ in range(m)]
+        self.frames = [0] * m  # per-lobby RollbackFrameCount
+        self.confirmed = [NULL_FRAME] * m
+        self.ticks = 0
+        self.rollbacks = 0
+        self.device_dispatches = 0
+        self.stalled = [0] * m
+        self._np = self.sessions[0].num_players()
+        for s in self.sessions:
+            if s.num_players() != self._np:
+                raise ValueError("all lobbies must share num_players "
+                                 "(one batched input tensor)")
+
+    # -- per-tick driver ----------------------------------------------------
+
+    def tick(self) -> None:
+        """One server tick: poll + step every lobby, flush as waves."""
+        self.ticks += 1
+        per_lobby_ops: List[List[_Op]] = []
+        for b, s in enumerate(self.sessions):
+            per_lobby_ops.append(self._collect_ops(b, s))
+        n_waves = max((len(ops) for ops in per_lobby_ops), default=0)
+        for w in range(n_waves):
+            wave_ops = [
+                ops[w] if w < len(ops) else None for ops in per_lobby_ops
+            ]
+            self._do_loads(wave_ops)
+            self._do_runs(wave_ops)
+        for b, s in enumerate(self.sessions):
+            cf = s.confirmed_frame()
+            self.confirmed[b] = cf
+            self.rings[b].confirm(cf)
+
+    def _collect_ops(self, b: int, s) -> List[_Op]:
+        if hasattr(s, "poll_remote_clients"):
+            s.poll_remote_clients()
+        if hasattr(s, "events") and self.on_event is not None:
+            for ev in s.events():
+                self.on_event(b, ev)
+        if isinstance(s, SyncTestSession):
+            handles = list(range(s.num_players()))
+        else:
+            if s.current_state() != SessionState.RUNNING:
+                return []  # still handshaking: poll only
+            handles = list(s.local_player_handles())
+        for h, v in self.read_inputs(b, handles).items():
+            s.add_local_input(h, v)
+        try:
+            with span("SessionAdvanceFrame"):
+                requests = s.advance_frame()
+        except MismatchedChecksumError as e:
+            if self.on_mismatch is not None:
+                self.on_mismatch(b, e)
+                return []
+            raise
+        except PredictionThresholdError:
+            self.stalled[b] += 1
+            return []
+        except NotSynchronizedError:
+            return []
+        return _split_ops(requests)
+
+    # -- loads --------------------------------------------------------------
+
+    def _do_loads(self, wave_ops: List[Optional[_Op]]) -> None:
+        loads = [
+            (b, op.load_frame)
+            for b, op in enumerate(wave_ops)
+            if op is not None and op.load_frame is not None
+        ]
+        if not loads:
+            return
+        self.rollbacks += len(loads)
+        with span("LoadWorldBatched"):
+            fused = self._try_fused_load(loads)
+            if fused is not None:
+                self.worlds = fused
+                for b, f in loads:
+                    _, cs = self.rings[b].rollback(f)
+                    self._world_checksum[b] = cs
+            else:
+                for b, f in loads:
+                    stored, cs = self.rings[b].rollback(f)
+                    state = self.app.reg.load_state(materialize(stored))
+                    self.worlds = _set_row(self.worlds, b, state)
+                    self._world_checksum[b] = cs
+            for b, f in loads:
+                self.frames[b] = f
+
+    def _try_fused_load(self, loads):
+        """Lockstep fast path: every lobby rolls back to a row of the SAME
+        past dispatch's ``[M, K, ...]`` stacked buffer at the same frame
+        index, with lane == lobby (the M-identical-SyncTest shape) — one
+        gather replaces M scatters."""
+        if len(loads) != len(self.sessions):
+            return None
+        if not self.app.reg.is_identity_strategy():
+            return None
+        src = None
+        idx = None
+        for b, f in loads:
+            stored, _ = self.rings[b].rollback(f)
+            if not (isinstance(stored, LazySlice)
+                    and isinstance(stored._i, tuple)):
+                return None
+            bb, ii = stored._i
+            if bb != b:
+                return None
+            if src is None:
+                src, idx = stored._stacked, ii
+            elif stored._stacked is not src or ii != idx:
+                return None
+        return _gather_frame(src, idx)
+
+    # -- runs ---------------------------------------------------------------
+
+    def _do_runs(self, wave_ops: List[Optional[_Op]]) -> None:
+        m = len(self.sessions)
+        runs = [op.run if op is not None else None for op in wave_ops]
+        adv = [
+            [r for r in (run or []) if isinstance(r, AdvanceRequest)]
+            for run in runs
+        ]
+        ks = [len(a) for a in adv]
+        if not any(run for run in runs):
+            return
+        k_hot = max(ks)
+        if k_hot > self.k_max:
+            raise ValueError(
+                f"lobby requested a {k_hot}-frame run > k_max={self.k_max}; "
+                "raise BatchedRunner(k_max=...)"
+            )
+        identity = self.app.reg.is_identity_strategy()
+        stacked = batch = None
+        pre_checksum = list(self._world_checksum)
+        prev_worlds = self.worlds
+        if k_hot > 0:
+            inputs = np.zeros(
+                (m, self.k_max, self._np, *self.app.input_shape),
+                self.app.input_dtype,
+            )
+            status = np.zeros((m, self.k_max, self._np), np.int8)
+            n_real = np.zeros((m,), np.int32)
+            starts = np.asarray(self.frames, np.int32)
+            for b, a in enumerate(adv):
+                if not a:
+                    continue
+                seq = np.stack([x.inputs for x in a])
+                st = np.stack([x.status for x in a])
+                inputs[b] = pad_repeat_last(seq, self.k_max - len(a))
+                status[b] = pad_repeat_last(st, self.k_max - len(a))
+                n_real[b] = len(a)
+            self.device_dispatches += 1
+            with span("AdvanceWorldBatched"):
+                finals, stacked, checks_flat = self.fn(
+                    self.worlds, inputs, status, starts, n_real
+                )
+                batch = BatchChecks(checks_flat)
+                self.worlds = finals
+                for b in range(m):
+                    if ks[b] > 0:
+                        self.frames[b] = frame_add(self.frames[b], ks[b])
+                        self._world_checksum[b] = batch.ref(
+                            b * self.k_max + ks[b] - 1
+                        )
+        with span("SaveWorldBatched"):
+            for b, run in enumerate(runs):
+                if not run:
+                    continue
+                c = 0
+                for r in run:
+                    if isinstance(r, AdvanceRequest):
+                        c += 1
+                        continue
+                    if c == 0:
+                        # pre-dispatch save: slice the PREVIOUS resident
+                        # world's row (still alive in prev_worlds); its
+                        # checksum handle was tracked, not recomputed
+                        state_s = LazySlice(prev_worlds, b)
+                        cs = pre_checksum[b]
+                    else:
+                        cs = batch.ref(b * self.k_max + (c - 1))
+                        state_s = LazySlice(stacked, (b, c - 1))
+                    stored = (
+                        state_s
+                        if identity
+                        else self.app.reg.store_state(state_s.materialize())
+                    )
+                    self.rings[b].push(r.frame, (stored, cs))
+                    r.cell.save(r.frame, cs.to_int)
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "lobbies": len(self.sessions),
+            "ticks": self.ticks,
+            "rollbacks": self.rollbacks,
+            "device_dispatches": self.device_dispatches,
+            "stalled_frames": list(self.stalled),
+            "frames": list(self.frames),
+            "confirmed": list(self.confirmed),
+        }
+
+    def lobby_world(self, b: int):
+        """Materialize lobby ``b``'s live world (one gather dispatch)."""
+        return _row(self.worlds, b)
+
+    def lobby_checksum(self, b: int) -> int:
+        """Lobby ``b``'s live 64-bit world checksum (forces the fused
+        batched pull — see snapshot/lazy.py)."""
+        from .snapshot.checksum import checksum_to_int
+
+        return checksum_to_int(self._world_checksum[b])
+
+    def finish(self) -> None:
+        """Flush deferred checksum comparisons on every lobby session."""
+        for b, s in enumerate(self.sessions):
+            if hasattr(s, "check_now"):
+                try:
+                    s.check_now()
+                except MismatchedChecksumError as e:
+                    if self.on_mismatch is not None:
+                        self.on_mismatch(b, e)
+                    else:
+                        raise
+
+
+# -- jitted row helpers (one dispatch each; compiled once) -------------------
+
+_row_jit = None
+_set_row_jit = None
+_gather_frame_jit = None
+
+
+def _row(tree, b: int):
+    global _row_jit
+    import jax
+
+    if _row_jit is None:
+        _row_jit = jax.jit(lambda t, i: jax.tree.map(lambda a: a[i], t))
+    return _row_jit(tree, np.int32(b))
+
+
+def _set_row(tree, b: int, row):
+    global _set_row_jit
+    import jax
+
+    if _set_row_jit is None:
+        _set_row_jit = jax.jit(
+            lambda t, i, r: jax.tree.map(lambda a, x: a.at[i].set(x), t, r)
+        )
+    return _set_row_jit(tree, np.int32(b), row)
+
+
+def _gather_frame(stacked, i: int):
+    """[M, K, ...] stacked -> [M, ...] at frame index i (lockstep load)."""
+    global _gather_frame_jit
+    import jax
+
+    if _gather_frame_jit is None:
+        _gather_frame_jit = jax.jit(
+            lambda t, ii: jax.tree.map(lambda a: a[:, ii], t)
+        )
+    return _gather_frame_jit(stacked, np.int32(i))

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..snapshot.world import WorldState
-from .resim import resim
+from .resim import resim, resim_padded
 
 
 def stack_worlds(worlds: List[WorldState]) -> WorldState:
@@ -82,3 +82,37 @@ def make_batched_resim_fn(app):
         )(batched_world, inputs_b, status_b, start_frames)
 
     return fn
+
+
+def make_batched_padded_fn(app, k_max: int, donate: bool = False):
+    """jit(vmap(resim_padded)) over the lobby axis — the BatchedRunner's
+    dispatch: every lobby advances up to ``k_max`` frames in ONE call, with
+    per-lobby ``n_real`` masking (a lobby with no pending work passes its
+    lane through unchanged at ``n_real=0``).
+
+    ``fn(batched_world[M], inputs[M, k_max, P, ...], status[M, k_max, P],
+    start_frames[M], n_real[M]) -> (finals[M], stacked[M, k_max],
+    checksums_flat[M * k_max, 2])`` — checksums come out pre-flattened so
+    one BatchChecks wraps the whole dispatch (row ``b * k_max + i``).
+
+    Same canonical-mode refusal (and rationale) as
+    :func:`make_batched_resim_fn`.  ``donate=True`` donates the batched
+    world for in-place lane updates (the server's resident-world fast
+    path)."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+
+    def body(batched_world, inputs_b, status_b, start_frames, n_real):
+        finals, stacked, checks = jax.vmap(
+            lambda w, inp, st, f, nr: resim_padded(
+                reg, step, w, inp, st, f, nr, retention, fps, seed
+            )
+        )(batched_world, inputs_b, status_b, start_frames, n_real)
+        return finals, stacked, checks.reshape(-1, 2)
+
+    return jax.jit(body, donate_argnums=(0,) if donate else ())

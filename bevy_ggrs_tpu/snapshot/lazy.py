@@ -141,15 +141,20 @@ def wrap_single_checksum(cs) -> ChecksumRef:
 
 class LazySlice:
     """Deferred ``tree.map(a[i])`` over a stacked resim output — the ring
-    stores these so per-frame save slicing never dispatches unless loaded."""
+    stores these so per-frame save slicing never dispatches unless loaded.
+
+    ``i`` may also be an ``(outer, inner)`` pair for doubly-stacked buffers
+    (the BatchedRunner's ``[lobby, frame, ...]`` dispatch outputs)."""
 
     __slots__ = ("_stacked", "_i")
 
-    def __init__(self, stacked, i: int):
+    def __init__(self, stacked, i):
         self._stacked = stacked
         self._i = i
 
     def materialize(self):
+        if isinstance(self._i, tuple):
+            return tree_index2(self._stacked, *self._i)
         return tree_index(self._stacked, self._i)
 
 
@@ -175,3 +180,19 @@ def tree_index(stacked, i: int):
 
 
 _tree_index_jit = None
+
+
+def tree_index2(stacked, b: int, i: int):
+    """``tree.map(a[b, i])`` as ONE jitted dispatch (doubly-stacked
+    ``[lobby, frame, ...]`` buffers; see :func:`tree_index`)."""
+    import jax
+
+    global _tree_index2_jit
+    if _tree_index2_jit is None:
+        _tree_index2_jit = jax.jit(
+            lambda t, bb, ii: jax.tree.map(lambda a: a[bb, ii], t)
+        )
+    return _tree_index2_jit(stacked, np.int32(b), np.int32(i))
+
+
+_tree_index2_jit = None
