@@ -43,7 +43,14 @@ class SpeculationConfig:
     # Memory note: the cache retains M x depth x max_cached_frames world
     # snapshots on device (they share nothing with the ring).  For a 10k-
     # entity world that is a few hundred KB per snapshot; for very large
-    # worlds lower depth/max_cached_frames or hedge fewer candidates.
+    # worlds lower depth/max_cached_frames or hedge fewer candidates —
+    # or set ``max_cached_bytes`` to let the cache bound itself.
+    #: Device-byte budget across all cached start frames (None = unbounded
+    #: beyond ``max_cached_frames``).  Oldest start frames evict first; the
+    #: NEWEST entry is always retained even if it alone exceeds the budget
+    #: (an empty cache would silently disable speculation), so the hard
+    #: ceiling is max(max_cached_bytes, one entry's footprint).
+    max_cached_bytes: Optional[int] = None
 
 
 class SpeculationCache:
@@ -53,9 +60,23 @@ class SpeculationCache:
         self.config = config
         # start_frame -> { input_bytes : (state, checksum) }
         self._cache: Dict[int, Dict[bytes, Tuple]] = {}
+        self._entry_bytes: Dict[int, int] = {}  # start_frame -> device bytes
         self.hits = 0
         self.misses = 0
         self.branches_evaluated = 0
+        self.bytes_evicted = 0  # device bytes dropped by the BYTE budget only
+
+    @property
+    def cached_bytes(self) -> int:
+        """Device bytes currently pinned by cached branch states."""
+        return sum(self._entry_bytes.values())
+
+    def _account(self, start_frame: int, entry: Dict) -> None:
+        from ..utils.mem import tree_device_bytes
+
+        self._entry_bytes[start_frame] = sum(
+            tree_device_bytes(branch) for branch in entry.values()
+        )
 
     def speculate(self, world, start_frame: int, used_inputs: np.ndarray) -> None:
         """Fan out candidate branches from ``world`` (the pre-advance state):
@@ -83,6 +104,7 @@ class SpeculationCache:
                 checks[b],
             )
         self._cache[start_frame] = (depth, entry)
+        self._account(start_frame, entry)
         self._trim()
 
     def fill_from_branched(self, start_frame: int, cands: np.ndarray,
@@ -103,6 +125,7 @@ class SpeculationCache:
             entry[key] = (stacked_slice, checks_b[b, offset:offset + depth_eff])
         self.branches_evaluated += cands.shape[0] * depth_eff
         self._cache[start_frame] = (depth_eff, entry)
+        self._account(start_frame, entry)
         self._trim()
 
     def lookup_seq(self, start_frame: int, inputs_seq: np.ndarray) -> Optional[Tuple]:
@@ -141,17 +164,30 @@ class SpeculationCache:
         d, states_fn, checks = got
         return states_fn(0), checks[0]
 
-    def _trim(self) -> None:
-        """Evict the OLDEST start frames past the cap, under wrapping frame
-        order (a plain ``sorted()`` would evict the newest at the i32 wrap)."""
+    def _oldest(self) -> int:
         from ..utils.frames import frame_lt
 
+        oldest = next(iter(self._cache))
+        for f in self._cache:
+            if frame_lt(f, oldest):
+                oldest = f
+        return oldest
+
+    def _drop(self, frame: int) -> int:
+        del self._cache[frame]
+        return self._entry_bytes.pop(frame, 0)
+
+    def _trim(self) -> None:
+        """Evict the OLDEST start frames past the frame cap and the device-
+        byte budget, under wrapping frame order (a plain ``sorted()`` would
+        evict the newest at the i32 wrap).  The newest entry always stays —
+        see ``SpeculationConfig.max_cached_bytes``."""
         while len(self._cache) > self.config.max_cached_frames:
-            oldest = next(iter(self._cache))
-            for f in self._cache:
-                if frame_lt(f, oldest):
-                    oldest = f
-            del self._cache[oldest]
+            self._drop(self._oldest())
+        budget = self.config.max_cached_bytes
+        if budget is not None:
+            while len(self._cache) > 1 and self.cached_bytes > budget:
+                self.bytes_evicted += self._drop(self._oldest())
 
     def invalidate_after(self, frame: int) -> None:
         """Drop entries whose base state a rollback to ``frame`` invalidates.
@@ -167,9 +203,11 @@ class SpeculationCache:
 
         for s in [s for s in self._cache if frame_gt(s, frame)]:
             del self._cache[s]
+            self._entry_bytes.pop(s, None)
 
     def clear(self) -> None:
         self._cache.clear()
+        self._entry_bytes.clear()
 
 
 def jax_tree_slice(tree, idx):

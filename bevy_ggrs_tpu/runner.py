@@ -93,6 +93,7 @@ class GgrsRunner:
         self.rollbacks = 0
         self.rollback_frames = 0  # total resimulated frames
         self.device_dispatches = 0
+        self.donated_dispatches = 0  # dispatches that donated the input world
         # HBM guard for lazy ring saves: storing LazySlice handles keeps the
         # whole [k, ...] stacked resim buffer alive while ANY of its frames
         # is ringed — O(ring_depth x k) world copies worst case.  Above this
@@ -264,9 +265,11 @@ class GgrsRunner:
             "rollbacks": self.rollbacks,
             "resimulated_frames": self.rollback_frames,
             "device_dispatches": self.device_dispatches,
+            "donated_dispatches": self.donated_dispatches,
             "stalled_frames": self.stalled_frames,
             "speculation_hits": getattr(self.spec_cache, "hits", 0),
             "speculation_misses": getattr(self.spec_cache, "misses", 0),
+            "speculation_cached_bytes": getattr(self.spec_cache, "cached_bytes", 0),
             "frame": self.frame,
             "confirmed": self.confirmed,
         }
@@ -492,6 +495,8 @@ class GgrsRunner:
                         self.app.resim_fn_donated if donate
                         else self.app.resim_fn
                     )
+                    if donate:
+                        self.donated_dispatches += 1
                     final, stacked, checks = fn(
                         self.world, inputs, status, self.frame
                     )
@@ -507,12 +512,11 @@ class GgrsRunner:
                 self._world_donatable = True  # final is a fresh buffer
         materialize_saves = False
         if stacked is not None:
-            import jax as _jax
+            from .utils.mem import tree_device_bytes
 
-            stacked_bytes = sum(
-                a.size * a.dtype.itemsize for a in _jax.tree.leaves(stacked)
+            materialize_saves = (
+                tree_device_bytes(stacked) > self.ring_materialize_bytes
             )
-            materialize_saves = stacked_bytes > self.ring_materialize_bytes
         pushed_pre_world = False
         with span("SaveWorld"):
             c = 0  # advances seen so far within the run

@@ -21,6 +21,7 @@ from ..utils.frames import (
     NULL_FRAME,
     frame_add,
     frame_diff,
+    frame_ge,
     frame_gt,
     frame_le,
     frame_lt,
@@ -98,6 +99,7 @@ class P2PSession:
         self._confirmed = NULL_FRAME
         self.events_buf: List = []
         self._staged: Dict[int, np.ndarray] = {}
+        self._disc_corrected: set = set()  # addrs whose disconnect was resolved
 
         self.local_handles: List[int] = []
         self.remote_handle_addr: Dict[int, Any] = {}
@@ -228,6 +230,10 @@ class P2PSession:
             ep.poll()
             self.events_buf.extend(ep.events)
             ep.events.clear()
+        for addr, ep in self.endpoints.items():
+            if ep.disconnected and addr not in self._disc_corrected:
+                self._disc_corrected.add(addr)
+                self._force_disconnect_correction(addr)
         # retransmit un-acked local inputs + acks
         for ep in self.endpoints.values():
             if ep.state == SessionState.RUNNING and not ep.disconnected:
@@ -377,6 +383,43 @@ class P2PSession:
             inputs[h] = value
             status[h] = st
         return inputs, status
+
+    def _force_disconnect_correction(self, addr) -> None:
+        """A remote endpoint just hit the disconnect timeout: frames advanced
+        with served predictions for its handles will never be corrected by
+        the wire (its packets are dropped from here on), yet ``_inputs_for``
+        now reports DISCONNECTED/zero inputs for those handles.  Force the
+        mismatch-rollback NOW so resimulation bakes the disconnect policy in,
+        instead of leaving stale guesses live while ``_compute_confirmed``
+        (which skips disconnected remotes) leapfrogs past them — the
+        confirmed frame must never pass an uncorrected prediction (cf. the
+        pending-misprediction clamp in ``advance_frame``)."""
+        for h in self._handle_of_addr.get(addr, []):
+            q = self.queues[h]
+            # predictions at or below the contiguity mark are already
+            # validated — and pre-stream-base predictions (frame 0 with
+            # input delay) are permanently correct: the served default IS
+            # the input on every peer, so correcting them to DISCONNECTED
+            # would *create* divergence
+            pending = [
+                f for f in q._predictions
+                if frame_lt(f, self.current_frame)
+                and (
+                    q.last_confirmed == NULL_FRAME
+                    or frame_gt(f, q.last_confirmed)
+                )
+                and (q._base is None or frame_ge(f, q._base))
+            ]
+            if not pending:
+                continue
+            first = pending[0]
+            for f in pending[1:]:
+                if frame_lt(f, first):
+                    first = f
+            if q.first_incorrect == NULL_FRAME or frame_lt(
+                first, q.first_incorrect
+            ):
+                q.first_incorrect = first
 
     def _compute_confirmed(self) -> int:
         c = self.current_frame

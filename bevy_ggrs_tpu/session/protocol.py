@@ -68,6 +68,10 @@ NUM_SYNC_ROUNDTRIPS = 5
 SYNC_RETRY_S = 0.06
 QUALITY_INTERVAL_S = 0.2
 KEEP_ALIVE_S = 0.2
+# max contribution of a single inter-poll gap to the attended-quiet clock
+# (see PeerEndpoint.__init__ — bounds how much remote silence a host stall
+# can fabricate)
+ATTENDED_GAP_CAP_S = 0.25
 MAX_INPUTS_PER_PACKET = 64
 
 
@@ -102,6 +106,15 @@ class PeerEndpoint:
         self.disconnect_timeout_s = disconnect_timeout_s
         self.disconnect_notify_start_s = disconnect_notify_start_s
         self._last_recv = now_s()
+        # attended-quiet accounting: remote silence only counts toward the
+        # disconnect timeout while the host was actually polling.  Each
+        # inter-poll gap contributes at most ATTENDED_GAP_CAP_S, so a host
+        # stall (XLA compile of a new program variant, GC pause, debugger)
+        # does not read as seconds of remote silence and spuriously drop a
+        # live peer.  A genuinely dead peer still times out after
+        # ``disconnect_timeout_s`` of attended silence.
+        self._quiet_s = 0.0
+        self._last_poll = now_s()
         self._last_send = 0.0
         self._last_quality_sent = 0.0
         self.interrupted = False
@@ -167,6 +180,12 @@ class PeerEndpoint:
     # -- receiving ----------------------------------------------------------
 
     def handle(self, data: bytes) -> None:
+        if self.disconnected:
+            # once disconnected, always disconnected (ggrs semantics): a late
+            # packet from a dropped peer must not mutate input queues — the
+            # session may have advanced its confirmed frame past rollback
+            # range on the strength of the disconnect
+            return
         try:
             self._handle(data)
         except struct.error:
@@ -181,6 +200,8 @@ class PeerEndpoint:
         body = data[HDR.size:]
         was_quiet = self.interrupted
         self._last_recv = now_s()
+        self._quiet_s = 0.0
+        self._last_poll = self._last_recv  # the gap ending here held a packet
         if self.interrupted:
             self.interrupted = False
             self.events.append(NetworkResumed(self.addr))
@@ -271,8 +292,17 @@ class PeerEndpoint:
         """Advance timers: sync retries, quality reports, keepalive,
         disconnect detection."""
         t = now_s()
+        gap = max(t - self._last_poll, 0.0)
+        self._last_poll = t
         if self.disconnected:
             return
+        # silence accrues per attended poll, capped per gap: a multi-second
+        # host stall (e.g. jit compile of a new resim variant) contributes at
+        # most ATTENDED_GAP_CAP_S — and never more than half the timeout, so
+        # no single stall can trip even an aggressively short timeout
+        self._quiet_s += min(
+            gap, ATTENDED_GAP_CAP_S, 0.5 * self.disconnect_timeout_s
+        )
         if self.state == SessionState.SYNCHRONIZING:
             if t - self._last_sync_sent >= SYNC_RETRY_S:
                 self._last_sync_sent = t
@@ -293,7 +323,7 @@ class PeerEndpoint:
                 self.send_input_ack()
             else:
                 self._send(T_KEEP_ALIVE)
-        quiet = t - self._last_recv
+        quiet = self._quiet_s
         if quiet >= self.disconnect_timeout_s:
             self.disconnected = True
             self.events.append(Disconnected(self.addr))

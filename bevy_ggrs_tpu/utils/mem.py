@@ -1,0 +1,10 @@
+"""Device-memory accounting helpers."""
+
+from __future__ import annotations
+
+
+def tree_device_bytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree (device or host)."""
+    import jax
+
+    return sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(tree))

@@ -91,3 +91,81 @@ def test_donation_disabled_under_speculation():
     for _ in range(10):
         runner.tick()  # would raise on a deleted array if donation leaked
     runner.finish()
+
+
+def test_donation_p2p_under_latency():
+    """Round-4 regression shape: a P2P pair over a 3-hop-latency channel
+    with flipping inputs forces real rollbacks while the donation path is
+    active.  Round 4 shipped this red — the donated fn's compile stall
+    tripped the wall-clock disconnect timeout, the 'dead' peer's late
+    packets demanded a rollback below the pruned ring, and the driver
+    crashed (MissingSnapshotError).  Guards both the attended-quiet
+    liveness accounting and ring integrity on the donating dispatch path."""
+    from bevy_ggrs_tpu import PlayerType, SessionBuilder, SessionState
+    from bevy_ggrs_tpu.models import box_game
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+    from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+    net = ChannelNetwork(latency_hops=3, seed=3)
+    socks = [net.endpoint("d0"), net.endpoint("d1")]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"d{1 - i}")
+        )
+        session = b.start_p2p_session(socks[i])
+
+        def read_inputs(handles, i=i):
+            key = {0: "right", 1: "down"}[i]
+            return {h: box_game.keys_to_input(**{key: True}) for h in handles}
+
+        r = GgrsRunner(app, session, read_inputs=read_inputs)
+        assert r.enable_donation  # the default — this test exists to cover it
+        runners.append(r)
+
+    def drive(ticks, dt=1.0 / 60.0):
+        for _ in range(ticks):
+            net.deliver()
+            for r in runners:
+                r.update(dt)
+
+    drive(300, dt=0.0)
+    assert all(
+        r.session.current_state() == SessionState.RUNNING for r in runners
+    )
+    flip = [0]
+
+    def flipping(handles):
+        flip[0] += 1
+        return {
+            h: box_game.keys_to_input(right=(flip[0] // 5) % 2 == 0)
+            for h in handles
+        }
+
+    runners[0].read_inputs = flipping
+    drive(120)
+    # the shape exercised what it claims to: donation fired, rollbacks ran,
+    # and no endpoint was (spuriously) dropped
+    assert all(r.donated_dispatches > 0 for r in runners)
+    assert all(r.rollbacks > 0 for r in runners)
+    for r in runners:
+        assert all(
+            not ep.disconnected for ep in r.session.endpoints.values()
+        )
+    assert all(r.frame >= 100 for r in runners)
+    for _ in range(6):
+        shared = sorted(
+            set(runners[0].ring.frames()) & set(runners[1].ring.frames())
+        )
+        if shared:
+            break
+        drive(1)
+    assert shared
+    f = shared[-1]
+    assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
+        runners[1].ring.peek(f)[1]
+    )

@@ -1,0 +1,126 @@
+"""Speculation-cache HBM budget: ``SpeculationConfig.max_cached_bytes``
+bounds the device bytes pinned by hedge branches (the cache shares nothing
+with the ring — ops/speculation.py memory note).  Oldest start frames evict
+first; the newest entry always survives so speculation is never silently
+disabled by an undersized budget."""
+
+import numpy as np
+
+from bevy_ggrs_tpu import GgrsRunner
+from bevy_ggrs_tpu.models import stress
+from bevy_ggrs_tpu.ops.speculation import SpeculationCache, SpeculationConfig
+
+
+def _cache(n_entities, **cfg_kwargs):
+    app = stress.make_app(n_entities, capacity=n_entities)
+    config = SpeculationConfig(
+        candidates_fn=lambda last: np.stack(
+            [np.bitwise_xor(last, v) for v in (0, 1, 2, 3)]
+        ),
+        depth=2,
+        **cfg_kwargs,
+    )
+    return app, SpeculationCache(app, config)
+
+
+def _fill(app, cache, frames):
+    world = app.init_state()
+    used = np.zeros((2,), np.uint8)
+    for f in frames:
+        cache.speculate(world, f, used)
+    return world
+
+
+def test_budget_evicts_oldest_and_respects_cap():
+    app, cache = _cache(4096, max_cached_frames=64)
+    _fill(app, cache, [0])
+    per_entry = cache.cached_bytes
+    assert per_entry > 0
+    # budget for ~2.5 entries: the third insert must evict frame 0
+    cache.config.max_cached_bytes = int(per_entry * 2.5)
+    _fill(app, cache, [1, 2, 3, 4])
+    assert cache.cached_bytes <= cache.config.max_cached_bytes
+    kept = sorted(cache._cache)
+    assert kept == [3, 4]  # oldest-first eviction
+    assert cache.bytes_evicted >= 3 * per_entry
+
+
+def test_newest_entry_survives_undersized_budget():
+    app, cache = _cache(4096, max_cached_frames=64, max_cached_bytes=1)
+    _fill(app, cache, [0, 1])
+    assert sorted(cache._cache) == [1]  # never empty, newest kept
+    # and a lookup against the surviving entry still serves
+    got = cache.lookup(1, np.zeros((2,), np.uint8))
+    assert got is not None
+
+
+def test_budget_under_live_driver_large_world():
+    """Overflow behavior at large capacity: a 100k-entity world whose hedge
+    entries dwarf a small budget must keep hedging each tick while holding
+    at most one entry (a scripted session keeps every advance PREDICTED so
+    the driver speculates every tick and the cache would otherwise grow to
+    ``max_cached_frames`` 100k-world entries)."""
+    from bevy_ggrs_tpu.session.events import InputStatus
+    from bevy_ggrs_tpu.session.requests import AdvanceRequest, SaveCell, SaveRequest
+    from bevy_ggrs_tpu.session import SessionState as _SS
+
+    n = 100_000
+    app = stress.make_app(n, capacity=n)
+
+    class PredictingSession:
+        """Every tick: save + advance with the remote input PREDICTED."""
+
+        def __init__(self):
+            self.frame = 0
+
+        def num_players(self):
+            return 2
+
+        def max_prediction(self):
+            return 8
+
+        def confirmed_frame(self):
+            return -1
+
+        def current_state(self):
+            return _SS.RUNNING
+
+        def local_player_handles(self):
+            return [0]
+
+        def add_local_input(self, handle, value):
+            pass
+
+        def _on_cell_saved(self, frame, provider):
+            pass
+
+        def advance_frame(self):
+            status = np.zeros((2,), np.int8)
+            status[1] = InputStatus.PREDICTED
+            reqs = [
+                SaveRequest(self.frame, SaveCell(self, self.frame)),
+                AdvanceRequest(np.zeros((2,), np.uint8), status),
+            ]
+            self.frame += 1
+            return reqs
+
+    runner = GgrsRunner(
+        app, PredictingSession(),
+        read_inputs=lambda hs: {h: np.uint8(0) for h in hs},
+        speculation=SpeculationConfig(
+            candidates_fn=lambda last: np.stack(
+                [np.bitwise_xor(last, v) for v in (0, 1)]
+            ),
+            depth=1,
+            max_cached_bytes=1,  # pathologically small on purpose
+        ),
+    )
+    for _ in range(6):
+        runner.tick()
+    s = runner.stats()
+    assert len(runner.spec_cache._cache) <= 1
+    # the byte cap actually bit: entries were dropped for size
+    assert runner.spec_cache.bytes_evicted > 0
+    assert s["speculation_cached_bytes"] <= max(
+        runner.spec_cache._entry_bytes.values(), default=0
+    )
