@@ -28,6 +28,7 @@ class SessionBuilder:
         self._players: List[Player] = []
         self._disconnect_timeout_s = 2.0
         self._disconnect_notify_start_s = 0.5
+        self._catchup_speed = 1
         self._input_predictor = None
 
     @classmethod
@@ -79,6 +80,15 @@ class SessionBuilder:
     def with_disconnect_notify_delay(self, seconds: float) -> "SessionBuilder":
         """Seconds of peer silence before NetworkInterrupted."""
         self._disconnect_notify_start_s = seconds
+        return self
+
+    def with_catchup_speed(self, frames_per_tick: int) -> "SessionBuilder":
+        """Extra confirmed frames a lagging spectator replays per tick
+        (the reference's SessionBuilder::with_catchup_speed; spectator
+        sessions only)."""
+        if frames_per_tick < 1:
+            raise ValueError("catchup_speed must be >= 1")
+        self._catchup_speed = frames_per_tick
         return self
 
     def add_player(self, kind: PlayerType, handle: int, address: Any = None) -> "SessionBuilder":
@@ -158,6 +168,7 @@ class SessionBuilder:
             input_dtype=self.input_dtype,
             disconnect_timeout_s=self._disconnect_timeout_s,
             disconnect_notify_start_s=self._disconnect_notify_start_s,
+            catchup_speed=self._catchup_speed,
         )
 
     def start_spectator_session(self, host_addr: Any, socket) -> SpectatorSession:
@@ -169,4 +180,5 @@ class SessionBuilder:
             input_dtype=self.input_dtype,
             disconnect_timeout_s=self._disconnect_timeout_s,
             disconnect_notify_start_s=self._disconnect_notify_start_s,
+            catchup_speed=self._catchup_speed,
         )

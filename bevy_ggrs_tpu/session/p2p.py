@@ -396,6 +396,14 @@ class P2PSession:
         pending-misprediction clamp in ``advance_frame``)."""
         for h in self._handle_of_addr.get(addr, []):
             q = self.queues[h]
+            if q._base is None and q.last_confirmed == NULL_FRAME:
+                # nothing of this stream ever arrived: every served
+                # prediction was the default input — exactly the value the
+                # disconnect policy substitutes — and with no base we cannot
+                # tell pre-stream frames apart.  A status-only rollback here
+                # would *create* divergence against peers that saw more of
+                # the stream, so leave the predictions baked in.
+                continue
             # predictions at or below the contiguity mark are already
             # validated — and pre-stream-base predictions (frame 0 with
             # input delay) are permanently correct: the served default IS

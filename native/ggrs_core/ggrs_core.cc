@@ -46,6 +46,10 @@ constexpr uint8_t T_SYNC_REQ = 1, T_SYNC_REP = 2, T_INPUT = 3, T_INPUT_ACK = 4,
 constexpr int NUM_SYNC_ROUNDTRIPS = 5;
 constexpr double SYNC_RETRY_S = 0.06, QUALITY_INTERVAL_S = 0.2,
                  KEEP_ALIVE_S = 0.2;
+/* max contribution of one inter-poll gap to the attended-quiet clock
+ * (mirrors session/protocol.py ATTENDED_GAP_CAP_S: a host stall must not
+ * read as remote silence and spuriously drop a live peer) */
+constexpr double ATTENDED_GAP_CAP_S = 0.25;
 constexpr int MAX_INPUTS_PER_PACKET = 64;
 /* absolute bound on un-acked send history (frames; ~68 s at 60 fps).  The
  * ack-driven trim keeps these deques tiny normally, and a silent peer hits
@@ -172,6 +176,10 @@ struct Endpoint {
   int sync_remaining = NUM_SYNC_ROUNDTRIPS;
   double last_sync_sent = 0, last_recv = 0, last_send = 0, last_quality = 0;
   double disconnect_timeout_s = 2.0, disconnect_notify_s = 0.5, created = 0;
+  /* attended-quiet accounting (see session/protocol.py): silence accrues
+   * per poll, each gap capped, so only time the host spent listening counts
+   * toward the disconnect timeout */
+  double quiet_s = 0, last_poll = 0;
   bool interrupted = false, disconnected = false;
   TimeSync time_sync;
   Frame last_acked = NULL_FRAME;        /* newest of OUR inputs peer has */
@@ -194,7 +202,7 @@ struct Endpoint {
   Frame base_inbox = NULL_FRAME;  /* peer stream base, delivered once */
   bool have_base_inbox = false;
 
-  void init(double now) { last_recv = now; created = now; }
+  void init(double now) { last_recv = now; created = now; last_poll = now; }
 
   void send(uint8_t type, const Writer &body) {
     Writer w;
@@ -250,10 +258,14 @@ struct Endpoint {
   }
 
   void handle(const uint8_t *data, size_t n) {
+    if (disconnected) return; /* once disconnected, always disconnected:
+                               * late packets must not mutate input queues */
     Reader r(data, n);
     if (r.u16() != MAGIC) return;
     uint8_t t = r.u8();
     last_recv = now_s();
+    quiet_s = 0;
+    last_poll = last_recv; /* the gap ending here held a packet */
     if (interrupted) { interrupted = false; events.push_back({GGRS_EV_RESUMED, 0, 0, addr}); }
     switch (t) {
       case T_SYNC_REQ: {
@@ -349,7 +361,12 @@ struct Endpoint {
 
   void poll() {
     double t = now_s();
+    double gap = t - last_poll;
+    if (gap < 0) gap = 0;
+    last_poll = t;
     if (disconnected) return;
+    double cap = std::min(ATTENDED_GAP_CAP_S, 0.5 * disconnect_timeout_s);
+    quiet_s += std::min(gap, cap);
     if (state == GGRS_SYNCHRONIZING) {
       if (t - last_sync_sent >= SYNC_RETRY_S) send_sync_request();
       return;
@@ -366,7 +383,7 @@ struct Endpoint {
       if (last_received_frame != NULL_FRAME) send_input_ack();
       else { Writer b; send(T_KEEP_ALIVE, b); }
     }
-    double quiet = t - last_recv;
+    double quiet = quiet_s;
     if (quiet >= disconnect_timeout_s) {
       disconnected = true;
       events.push_back({GGRS_EV_DISCONNECTED, 0, 0, addr});
@@ -490,6 +507,7 @@ struct GgrsP2P {
   std::deque<std::pair<Frame, std::vector<uint8_t>>> spectator_sent;
   Frame next_spectator_frame = 0;
   std::vector<InputQueue> queues;
+  std::vector<Addr> disc_corrected; /* addrs whose disconnect was resolved */
   std::map<int, std::vector<uint8_t>> staged;
   std::deque<std::pair<Frame, std::vector<uint8_t>>> local_sent;
   std::deque<Event> events;
@@ -638,6 +656,41 @@ void ggrs_p2p_poll(GgrsP2P *s) {
     ep->checksum_inbox.clear();
     if (ep->state == GGRS_RUNNING && !ep->disconnected)
       ep->send_inputs(s->local_sent);
+  }
+  /* a remote just hit the disconnect timeout: frames advanced on its served
+   * predictions will never be corrected by the wire (late packets are
+   * dropped), yet input_for now reports DISCONNECTED/zero for its handles.
+   * Force the mismatch-rollback now, BEFORE compute_confirmed (which skips
+   * disconnected remotes) can leapfrog the uncorrected frames and the ring
+   * prunes the rollback target (mirrors P2PSession._force_disconnect_
+   * correction).  Pre-stream-base predictions are permanently correct (the
+   * served default IS the input on every peer) and stay untouched. */
+  for (auto &[addr, ep] : s->endpoints) {
+    if (!ep->disconnected) continue;
+    bool seen = false;
+    for (auto &a : s->disc_corrected) seen |= (a == addr);
+    if (seen) continue;
+    s->disc_corrected.push_back(addr);
+    for (int h : s->handles_of_addr[addr]) {
+      auto &q = s->queues[h];
+      /* nothing of this stream ever arrived: served predictions were the
+       * default input (== the disconnect substitute) and pre-stream frames
+       * are indistinguishable — a status-only rollback would CREATE
+       * divergence against peers that saw more of the stream */
+      if (!q.have_base && q.last_confirmed == NULL_FRAME) continue;
+      Frame first = NULL_FRAME;
+      for (auto &[f, v] : q.predictions) {
+        if (!frame_lt(f, s->current_frame)) continue;
+        if (q.last_confirmed != NULL_FRAME && frame_le(f, q.last_confirmed))
+          continue;
+        if (q.have_base && frame_lt(f, q.base)) continue;
+        if (first == NULL_FRAME || frame_lt(f, first)) first = f;
+      }
+      if (first != NULL_FRAME &&
+          (q.first_incorrect == NULL_FRAME ||
+           frame_lt(first, q.first_incorrect)))
+        q.first_incorrect = first;
+    }
   }
 }
 

@@ -206,7 +206,53 @@ static void test_spectator_follows_host() {
   ggrs_p2p_destroy(peer);
 }
 
+static void test_host_stall_liveness() {
+  /* Attended-quiet accounting (mirrors tests/test_protocol_liveness.py):
+   * a host stall longer than the disconnect timeout must NOT drop a live
+   * peer — only attended silence counts.  Then genuinely killing one peer
+   * must still disconnect it after ~timeout of attended polling. */
+  GgrsP2P *a = ggrs_p2p_create(2, 1, 0, 8, 1, 0, 0.4, 0.2);
+  GgrsP2P *b = ggrs_p2p_create(2, 1, 0, 8, 1, 0, 0.4, 0.2);
+  uint16_t pa = ggrs_p2p_local_port(a), pb = ggrs_p2p_local_port(b);
+  ggrs_p2p_add_player(a, GGRS_LOCAL, 0, nullptr, 0);
+  ggrs_p2p_add_player(a, GGRS_REMOTE, 1, "127.0.0.1", pb);
+  ggrs_p2p_add_player(b, GGRS_REMOTE, 0, "127.0.0.1", pa);
+  ggrs_p2p_add_player(b, GGRS_LOCAL, 1, nullptr, 0);
+  ggrs_p2p_start(a);
+  ggrs_p2p_start(b);
+  for (int i = 0; i < 2000 && !(ggrs_p2p_state(a) == GGRS_RUNNING &&
+                                ggrs_p2p_state(b) == GGRS_RUNNING); i++) {
+    ggrs_p2p_poll(a);
+    ggrs_p2p_poll(b);
+  }
+  CHECK(ggrs_p2p_state(a) == GGRS_RUNNING);
+  /* host stall: 2x the timeout with NO polling on either side */
+  usleep(800 * 1000);
+  ggrs_p2p_poll(a);
+  ggrs_p2p_poll(b);
+  int32_t kind, arg;
+  uint64_t big, big2;
+  char addrbuf[64];
+  bool disconnected = false;
+  while (ggrs_p2p_next_event(a, &kind, &arg, &big, &big2, addrbuf,
+                             sizeof addrbuf))
+    disconnected |= (kind == GGRS_EV_DISCONNECTED);
+  CHECK(!disconnected); /* the stall must not read as remote silence */
+  /* now kill b for real: poll only a at ~60 Hz until the timeout fires */
+  for (int i = 0; i < 120 && !disconnected; i++) {
+    usleep(16 * 1000);
+    ggrs_p2p_poll(a);
+    while (ggrs_p2p_next_event(a, &kind, &arg, &big, &big2, addrbuf,
+                               sizeof addrbuf))
+      disconnected |= (kind == GGRS_EV_DISCONNECTED);
+  }
+  CHECK(disconnected); /* attended silence still disconnects */
+  ggrs_p2p_destroy(a);
+  ggrs_p2p_destroy(b);
+}
+
 int main() {
+  test_host_stall_liveness();
   test_spectator_follows_host();
   test_packet_fuzz();
   test_invalid_usage();

@@ -276,3 +276,66 @@ def test_native_spectator_follows_python_host():
     assert float(r_spec.world.comps["pos"][0, 0]) > 1.9
     sock0.close()
     sock1.close()
+
+
+def test_native_spectator_catchup():
+    """Lag a NATIVE spectator behind a python host, then assert it closes
+    the gap at 1 + catchup_speed frames per tick (mirrors
+    test_p2p.py::test_spectator_catchup; C++ side: ggrs_spectator_advance's
+    catch-up loop, /root/reference/tests/p2p.rs:202-260 for the pattern)."""
+    from bevy_ggrs_tpu import SessionBuilder as SB
+
+    catchup = 3
+    p_host, p_peer, p_spec = free_ports(3)
+    app0 = box_game.make_app(num_players=2)
+    sock0 = UdpNonBlockingSocket(p_host, host="0.0.0.0")
+    b0 = (
+        SB.for_app(app0)
+        .with_input_delay(1)
+        .add_player(PlayerType.LOCAL, 0)
+        .add_player(PlayerType.REMOTE, 1, ("127.0.0.1", p_peer))
+        .add_player(PlayerType.SPECTATOR, 2, ("127.0.0.1", p_spec))
+    )
+    r0 = GgrsRunner(
+        app0, b0.start_p2p_session(sock0),
+        read_inputs=lambda hs: {h: box_game.keys_to_input(right=True) for h in hs},
+    )
+    app1 = box_game.make_app(num_players=2)
+    sock1 = UdpNonBlockingSocket(p_peer, host="0.0.0.0")
+    b1 = (
+        SB.for_app(app1)
+        .with_input_delay(1)
+        .add_player(PlayerType.REMOTE, 0, ("127.0.0.1", p_host))
+        .add_player(PlayerType.LOCAL, 1)
+    )
+    r1 = GgrsRunner(app1, b1.start_p2p_session(sock1))
+
+    spec_app = box_game.make_app(num_players=2)
+    spec_session = (
+        SB.for_app(spec_app)
+        .with_catchup_speed(catchup)
+        .start_spectator_session_native(("127.0.0.1", p_host), local_port=p_spec)
+    )
+    r_spec = GgrsRunner(spec_app, spec_session)
+    everyone = [r0, r1, r_spec]
+    assert sync_all(everyone)
+
+    lag = 40
+    interleave([r0, r1], lag)
+    r_spec.update(0.0)  # drain only
+    assert spec_session.frames_behind_host() > 2 * catchup
+
+    behind0 = spec_session.frames_behind_host()
+    deltas = []
+    for _ in range(lag):
+        before = r_spec.frame
+        interleave(everyone, 1)
+        deltas.append(r_spec.frame - before)
+        if spec_session.frames_behind_host() <= 2:
+            break
+    assert max(deltas) == 1 + catchup
+    assert spec_session.frames_behind_host() <= 2
+    assert len(deltas) <= behind0 // catchup + 3
+    assert float(r_spec.world.comps["pos"][0, 0]) > 1.9
+    sock0.close()
+    sock1.close()

@@ -263,3 +263,77 @@ def test_p2p_session_restart():
     assert all(r.frame >= 15 for r in runners2)
     for s in socks2:
         s.close()
+
+
+def test_spectator_catchup():
+    """A lagging spectator replays 1 + catchup_speed confirmed frames per
+    tick until it closes the gap (the reference's catchup behavior,
+    /root/reference/tests/p2p.rs:202-260; spectator.py advance_frame)."""
+    catchup = 3
+    socks = [UdpNonBlockingSocket(0, host="127.0.0.1") for _ in range(3)]
+    addrs = [("127.0.0.1", s.local_addr[1]) for s in socks]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, addrs[1 - i])
+        )
+        if i == 0:
+            b.add_player(PlayerType.SPECTATOR, 2, addrs[2])
+        session = b.start_p2p_session(socks[i])
+        runners.append(
+            GgrsRunner(
+                app, session,
+                read_inputs=lambda hs: {
+                    h: box_game.keys_to_input(right=True) for h in hs
+                },
+            )
+        )
+
+    spec_app = box_game.make_app(num_players=2)
+    spec_session = (
+        SessionBuilder.for_app(spec_app)
+        .with_catchup_speed(catchup)
+        .start_spectator_session(addrs[0], socks[2])
+    )
+    assert spec_session.catchup_speed == catchup
+    spec_runner = GgrsRunner(spec_app, spec_session)
+    everyone = runners + [spec_runner]
+    for _ in range(300):
+        for r in everyone:
+            r.update(0.0)
+        if all(
+            r.session.current_state() == SessionState.RUNNING for r in everyone
+        ):
+            break
+        time.sleep(0.001)
+    assert spec_session.current_state() == SessionState.RUNNING
+
+    # lag the spectator: hosts advance 40 frames while it sits idle
+    lag = 40
+    interleave(runners, lag)
+    spec_runner.update(0.0)  # drain the socket only (no sim tick)
+    assert spec_session.frames_behind_host() > 2 * catchup
+
+    # now tick everyone: while behind, each spectator tick must replay
+    # 1 + catchup frames (host ticks add ~1 new confirmed frame each, so
+    # the gap shrinks by ~catchup per tick until it closes)
+    behind0 = spec_session.frames_behind_host()
+    deltas = []
+    for _ in range(lag):
+        before = spec_runner.frame
+        interleave(everyone, 1)
+        deltas.append(spec_runner.frame - before)
+        if spec_session.frames_behind_host() <= 2:
+            break
+    assert max(deltas) == 1 + catchup  # catchup rate honored while lagging
+    assert spec_session.frames_behind_host() <= 2  # gap actually closed
+    # and it closed at the catchup rate, not one-frame-at-a-time
+    assert len(deltas) <= behind0 // catchup + 3
+    # spectator replays the true world
+    assert float(spec_runner.world.comps["pos"][0, 0]) > 1.9
+    for s in socks:
+        s.close()

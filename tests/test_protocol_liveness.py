@@ -152,3 +152,33 @@ def test_disconnect_forces_correction_of_served_predictions():
     for _ in range(30):
         runners[0].update(1.0 / 60.0)
     assert runners[0].frame > before + 20
+
+
+def test_disconnect_of_never_heard_stream_forces_no_correction():
+    """If NOTHING of a peer's input stream ever arrived (no stream base, no
+    inputs), every served prediction was the default input — exactly what
+    the disconnect policy substitutes — so the correction must not fire: a
+    status-only rollback would CREATE divergence against peers that saw
+    more of the stream."""
+    net, runners = _latency_pair(latency_hops=3)
+    for _ in range(300):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(
+            r.session.current_state() == SessionState.RUNNING for r in runners
+        ):
+            break
+    # no game ticks yet: the remote stream has not started
+    s0 = runners[0].session
+    remote_h = [h for h in s0.queues if h not in s0.local_handles][0]
+    q = s0.queues[remote_h]
+    assert q._base is None and q.last_confirmed == NULL_FRAME
+    ep = s0.endpoints[s0.remote_handle_addr[remote_h]]
+    ep.disconnected = True
+    s0.poll_remote_clients()
+    assert q.first_incorrect == NULL_FRAME  # no correction forced
+    # and the survivor advances alone without crashing
+    for _ in range(30):
+        runners[0].update(1.0 / 60.0)
+    assert runners[0].frame >= 25
