@@ -131,3 +131,109 @@ def test_batched_runner_rejects_canonical_mode():
     app.canonical_depth = 8
     with pytest.raises(ValueError):
         BatchedRunner(app, [_session()])
+
+
+def test_batched_runner_staggered_p2p_rollback_waves():
+    """The realistic server shape: several independent P2P games in ONE
+    batch, each over a channel with a DIFFERENT latency/jitter, with
+    flipping inputs — rollback waves hit different lobbies on different
+    ticks, so load waves are partial (some lanes load while others
+    advance), exercising the scatter-load fallback rather than the
+    lockstep fused path the SyncTest tests cover.  Correctness oracle:
+    an INPUT-SENSITIVE model (fixed_point — the stress model's step
+    ignores inputs and would make this vacuous) whose two lanes per game
+    must be checksum-identical at every mutually CONFIRMED ring frame
+    (frames above confirmed may legitimately differ: one lane saved them
+    with the remote input still predicted)."""
+    from bevy_ggrs_tpu import PlayerType, SessionBuilder, SessionState
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+    from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+    GAMES = 3
+    app = fixed_point.make_app()
+    nets, sessions = [], []
+    for g in range(GAMES):
+        net = ChannelNetwork(
+            latency_hops=1 + g, jitter_hops=g, seed=100 + g
+        )
+        nets.append(net)
+        for i in range(2):
+            b = (SessionBuilder(input_shape=(), input_dtype=np.uint8)
+                 .with_num_players(2).with_input_delay(1)
+                 .with_max_prediction_window(8)
+                 .add_player(PlayerType.LOCAL, i)
+                 .add_player(PlayerType.REMOTE, 1 - i,
+                             f"g{g}b" if i == 0 else f"g{g}a"))
+            sessions.append(
+                b.start_p2p_session(net.endpoint(f"g{g}a" if i == 0 else f"g{g}b"))
+            )
+
+    tick_no = [0]
+
+    def read_inputs(lobby, handles):
+        game = lobby // 2
+        # different flip periods per game => mispredictions at different ticks
+        on = (tick_no[0] // (4 + 2 * game)) % 2 == 0
+        return {h: np.uint8(0x3 if on else 0xC) for h in handles}
+
+    br = BatchedRunner(app, sessions, read_inputs=read_inputs)
+
+    # record load-wave participation to prove waves were PARTIAL
+    wave_profile = []
+    orig_do_loads = br._do_loads
+
+    def spying_do_loads(wave_ops):
+        n_load = sum(
+            1 for op in wave_ops
+            if op is not None and op.load_frame is not None
+        )
+        if n_load:
+            wave_profile.append(n_load)
+        return orig_do_loads(wave_ops)
+
+    br._do_loads = spying_do_loads
+
+    def drive(n):
+        for _ in range(n):
+            tick_no[0] += 1
+            for net in nets:
+                net.deliver()
+            br.tick()
+
+    for _ in range(400):
+        for net in nets:
+            net.deliver()
+        br.tick()
+        if all(s.current_state() == SessionState.RUNNING for s in sessions):
+            break
+    assert all(s.current_state() == SessionState.RUNNING for s in sessions)
+    drive(120)
+
+    s = br.stats()
+    assert min(s["frames"]) > 80, s
+    assert br.rollbacks > 0
+    # staggered: at least one load wave covered SOME but not ALL lanes
+    assert wave_profile, "no rollback waves at all"
+    assert any(n < 2 * GAMES for n in wave_profile), wave_profile
+    # every game's two lanes agree at every mutually confirmed ring frame
+    from bevy_ggrs_tpu.utils.frames import frame_le
+
+    for g in range(GAMES):
+        a, b = 2 * g, 2 * g + 1
+        compared = 0
+        for _ in range(8):
+            conf = min(br.confirmed[a], br.confirmed[b])
+            shared = [
+                f for f in set(br.rings[a].frames()) & set(br.rings[b].frames())
+                if frame_le(f, conf)
+            ]
+            if shared:
+                break
+            drive(1)
+        assert shared, f"game {g}: no mutually confirmed ring frame"
+        for f in sorted(shared):
+            ca = checksum_to_int(br.rings[a].peek(f)[1])
+            cb = checksum_to_int(br.rings[b].peek(f)[1])
+            assert ca == cb, f"game {g} desynced at frame {f}"
+            compared += 1
+        assert compared > 0
