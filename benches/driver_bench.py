@@ -262,6 +262,48 @@ def bench_speculation_payoff(n_entities=2000, ticks=240):
         }))
 
 
+def bench_coalescing(n_entities=2000, frames=240, chunk=4):
+    """Catch-up shape: each host update owes `chunk` sim frames.  Measures
+    the same frame budget with coalesce_frames=1 (chunk dispatches per
+    update) vs coalesce_frames=chunk (one fused k=chunk dispatch) — the
+    tick-coalescing lever (docs/dispatch_floor.md).  On CPU the dispatch
+    overhead is small so the delta is modest; on a remote-attached device
+    each saved dispatch saves ~3 uploads x flat link latency."""
+    import numpy as np
+
+    from bevy_ggrs_tpu import GgrsRunner, SyncTestSession
+    from bevy_ggrs_tpu.models import stress
+
+    for coalesce in (1, chunk):
+        app = stress.make_app(n_entities, capacity=n_entities)
+        session = SyncTestSession(
+            num_players=2, input_shape=(), input_dtype=np.uint8,
+            check_distance=3,
+        )
+        runner = GgrsRunner(app, session, coalesce_frames=coalesce)
+        for _ in range(20):
+            runner.update(chunk / 60.0)  # warmup/compile both k shapes
+        warm_dispatches, warm_ticks = runner.device_dispatches, runner.ticks
+
+        def run(n, runner=runner):
+            for _ in range(n // chunk):
+                runner.update(chunk / 60.0)
+
+        med, spread = _timed_passes(run, frames)
+        print(json.dumps({
+            "metric": (
+                f"coalesce_{coalesce}_catchup_frames_per_sec_"
+                f"{n_entities}ent_chunk{chunk}"
+            ),
+            "value": round(med, 1), "unit": "frames/s",
+            "spread": round(spread, 3), "passes": PASSES,
+            # timed-passes-only counters (warmup excluded): THE dispatch
+            # reduction the feature exists to show
+            "dispatches": runner.device_dispatches - warm_dispatches,
+            "ticks": runner.ticks - warm_ticks,
+        }))
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -272,6 +314,8 @@ if __name__ == "__main__":
                     help="run only the speculation payoff matrix")
     ap.add_argument("--batched-only", action="store_true",
                     help="run only the batched-lobbies comparison")
+    ap.add_argument("--coalesce-only", action="store_true",
+                    help="run only the tick-coalescing comparison")
     args = ap.parse_args()
 
     print(json.dumps({"metric": "platform",
@@ -281,6 +325,8 @@ if __name__ == "__main__":
     elif args.batched_only:
         bench_batched_lobbies(m=16, n_entities=2000)
         bench_batched_lobbies(m=16, n_entities=10_000, ticks=30)
+    elif args.coalesce_only:
+        bench_coalescing()
     else:
         bench_synctest()
         bench_synctest(n_entities=100_000, ticks=100)
@@ -288,3 +334,4 @@ if __name__ == "__main__":
         bench_p2p_channel(n_entities=100_000, ticks=200)
         bench_batched_lobbies(m=16, n_entities=2000)
         bench_batched_lobbies(m=16, n_entities=10_000, ticks=30)
+        bench_coalescing()

@@ -50,6 +50,7 @@ class GgrsRunner:
         speculation: Optional[SpeculationConfig] = None,
         on_advance: Optional[Callable] = None,
         on_confirmed: Optional[Callable[[int], None]] = None,
+        coalesce_frames: int = 1,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
@@ -72,6 +73,20 @@ class GgrsRunner:
         self.events: List = []
         self.session = None
         self.stalled_frames = 0  # PredictionThreshold skips (observability)
+        # Tick coalescing: when one host update owes N > 1 sim frames (the
+        # run-behind / fast-forward / catch-up shapes), collect all N ticks'
+        # session requests and flush them through ONE _handle_requests call
+        # — consecutive advances fuse into a single k=N dispatch instead of
+        # N submissions (on remote-attached devices each submission costs
+        # flat link latency; the spectator's catchup path already emits
+        # multi-advance lists and proves the fused shape).  1 = flush every
+        # tick (the reference cadence).  Variant note: k already varies with
+        # rollback depth, so coalescing adds no NEW program-variant risk
+        # beyond what rollbacks pose (canonical mode pads either way), but
+        # canonical apps must keep coalesce_frames + window <= depth.
+        if coalesce_frames < 1:
+            raise ValueError("coalesce_frames must be >= 1")
+        self.coalesce_frames = coalesce_frames
         if (
             speculation is not None
             and app.canonical_depth is not None
@@ -171,13 +186,58 @@ class GgrsRunner:
                     "deepest rollback the session can request (see "
                     "ops/resim.py despawn-retirement invariant)"
                 )
+            if (
+                self.app.canonical_depth is not None
+                and self.coalesce_frames + window > self.app.canonical_depth
+            ):
+                # a rollback landing in the same coalesced flush as catch-up
+                # ticks fuses a (window + coalesce)-long run; the canonical
+                # program cannot pad past its fixed depth, so failing here
+                # beats a timing-dependent crash minutes into a session
+                raise ValueError(
+                    f"coalesce_frames ({self.coalesce_frames}) + rollback "
+                    f"window ({window}) exceeds canonical_depth "
+                    f"({self.app.canonical_depth}); lower coalesce_frames or "
+                    "raise App(canonical_depth=...)"
+                )
+            if (
+                isinstance(session, SyncTestSession)
+                and self.coalesce_frames
+                > session.check_distance + session.compare_interval() + 2
+            ):
+                # the session GCs comparison cells check_distance +
+                # compare_interval + 2 frames back every advance; a deeper
+                # flush cadence would land resim checksums AFTER the cell
+                # was collected, silently skipping those comparisons — the
+                # determinism oracle must fail loudly instead of thinning
+                raise ValueError(
+                    f"coalesce_frames ({self.coalesce_frames}) exceeds the "
+                    "SyncTest comparison-cell horizon (check_distance + "
+                    "compare_interval + 2 = "
+                    f"{session.check_distance + session.compare_interval() + 2}"
+                    "); lower coalesce_frames or raise check_distance/"
+                    "compare_interval"
+                )
             # ring must hold a snapshot window frames back even if a session
             # reports rollback_window > max_prediction
-            self.ring.set_depth(max(mp, window) + 2)
+            self.ring.set_depth(self._ring_depth(session))
             # sessions may start at a nonzero frame (wraparound tests, resumed
             # sessions); mirror it so ctx.frame/time agree from tick one
             cur = getattr(session, "current_frame", 0)
             self.frame = cur() if callable(cur) else cur
+
+    def _ring_depth(self, session) -> int:
+        """Snapshot-ring capacity: the deepest rollback window the session
+        can request, plus every save a maximally coalesced flush can push
+        before the end-of-flush confirm prunes (one formula — a second copy
+        drifting from this one is how rings get undersized)."""
+        mp = session.max_prediction()
+        window = (
+            session.rollback_window()
+            if hasattr(session, "rollback_window")
+            else mp
+        )
+        return max(mp, window) + 1 + self.coalesce_frames
 
     def _flush_session_checks(self) -> None:
         """Force any deferred checksum comparisons on the current session,
@@ -213,12 +273,23 @@ class GgrsRunner:
             with span("PollRemoteClients"):
                 self.session.poll_remote_clients()
             self._drain_events()
+        pending: List[GgrsRequest] = []
+        pending_ticks = 0
         while self.accumulator >= fps_delta:
             self.accumulator -= fps_delta
             if hasattr(self.session, "frames_ahead"):
                 self.run_slow = self.session.frames_ahead() > 0
-            self._step_session()
+            reqs = self._step_session()
+            if reqs:
+                pending.extend(reqs)
+                pending_ticks += 1
+                if pending_ticks >= self.coalesce_frames:
+                    self._handle_requests(pending)
+                    pending = []
+                    pending_ticks = 0
             fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
+        if pending:
+            self._handle_requests(pending)
 
     @property
     def checksum(self) -> int:
@@ -281,32 +352,33 @@ class GgrsRunner:
 
     # -- per-session-type steps ---------------------------------------------
 
-    def _step_session(self) -> None:
+    def _step_session(self) -> Optional[List[GgrsRequest]]:
+        """One session tick: returns its request list (to be flushed by the
+        caller — possibly coalesced with other ticks'), or None if the tick
+        produced nothing (stall, handshake, mismatch)."""
         self.ticks += 1
         s = self.session
         if isinstance(s, SyncTestSession):
-            self._step_synctest()
-        elif getattr(s, "is_spectator", False):
-            self._step_spectator()
-        else:
-            self._step_p2p()
+            return self._step_synctest()
+        if getattr(s, "is_spectator", False):
+            return self._step_spectator()
+        return self._step_p2p()
 
-    def _step_synctest(self) -> None:
+    def _step_synctest(self) -> Optional[List[GgrsRequest]]:
         s = self.session
         self.local_players = list(range(s.num_players()))
         for handle, value in self.read_inputs(self.local_players).items():
             s.add_local_input(handle, value)
         try:
             with span("SessionAdvanceFrame"):
-                requests = s.advance_frame()
+                return s.advance_frame()
         except MismatchedChecksumError as e:
             trace_log("SyncTest mismatch: %s", e)
             if self.on_mismatch is not None:
                 self.on_mismatch(e)
-            return
-        self._handle_requests(requests)
+            return None
 
-    def _step_p2p(self) -> None:
+    def _step_p2p(self) -> Optional[List[GgrsRequest]]:
         s = self.session
         self.local_players = list(s.local_player_handles())
         if s.current_state() == SessionState.RUNNING:
@@ -318,26 +390,25 @@ class GgrsRunner:
         except PredictionThresholdError:
             trace_log("frame %d skipped: prediction threshold", self.frame)
             self.stalled_frames += 1
-            return
+            return None
         except NotSynchronizedError:
-            return  # still in the sync handshake; sim time does not advance
+            return None  # still in the sync handshake; sim time does not advance
         self._drain_events()
-        self._handle_requests(requests)
+        return requests
 
-    def _step_spectator(self) -> None:
+    def _step_spectator(self) -> Optional[List[GgrsRequest]]:
         s = self.session
         self.local_players = []
         if s.current_state() != SessionState.RUNNING:
-            return
+            return None
         try:
-            requests = s.advance_frame()
+            return s.advance_frame()
         except PredictionThresholdError:
             trace_log("spectator frame skipped: waiting for host input")
             self.stalled_frames += 1
-            return
+            return None
         except NotSynchronizedError:
-            return
-        self._handle_requests(requests)
+            return None
 
     def _drain_events(self) -> None:
         s = self.session
@@ -353,14 +424,8 @@ class GgrsRunner:
         with span("HandleRequests"):
             s = self.session
             # mirror session -> driver counters (schedule_systems.rs:195-220)
-            window = (
-                s.rollback_window()
-                if hasattr(s, "rollback_window")
-                else s.max_prediction()
-            )
-            self.ring.set_depth(max(s.max_prediction(), window) + 2)
+            self.ring.set_depth(self._ring_depth(s))
             self.confirmed = s.confirmed_frame()
-            self.ring.confirm(self.confirmed)  # discard_old_snapshots
             i = 0
             n = len(requests)
             while i < n:
@@ -376,6 +441,13 @@ class GgrsRunner:
                         j += 1
                     self._run_batch(requests[i:j])
                     i = j
+            # prune AFTER processing (discard_old_snapshots): with coalesced
+            # ticks, an early tick's Load can target a frame below a LATER
+            # tick's confirmed frame (the session takes first_incorrect per
+            # tick, then lets confirmed rise) — pruning up front would evict
+            # the rollback target, the exact MissingSnapshotError shape of
+            # the round-4 donation regression
+            self.ring.confirm(self.confirmed)
             # fire AFTER the batch: a corrective Load/Advance in the same
             # request list must land before observers treat the frame as
             # final (a replay watermark reading final_frames() from this
