@@ -23,6 +23,10 @@ from .session import (
     SessionBuilder,
     UdpNonBlockingSocket,
     TcpNonBlockingSocket,
+    RoomServer,
+    RoomSocket,
+    assign_handles,
+    wait_for_players,
     InputStatus,
     SessionState,
     PlayerType,

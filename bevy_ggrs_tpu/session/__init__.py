@@ -26,6 +26,7 @@ from .p2p import P2PSession
 from .spectator import SpectatorSession
 from .builder import SessionBuilder
 from .native import NativeP2PSession, native_available
+from .room import RoomServer, RoomSocket, assign_handles, wait_for_players
 from .replay import InputRecorder, ReplaySession
 
 __all__ = [
@@ -62,6 +63,10 @@ __all__ = [
     "SessionBuilder",
     "NativeP2PSession",
     "native_available",
+    "RoomServer",
+    "RoomSocket",
+    "assign_handles",
+    "wait_for_players",
     "InputRecorder",
     "ReplaySession",
 ]

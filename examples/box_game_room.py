@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""box_game over room matchmaking — the matchbox-style flow
+(/root/reference/README.md:79: matchbox pairs with the reference for
+browser P2P; here the same join-room → learn-peers → play contract runs
+over UDP via bevy_ggrs_tpu.session.room).
+
+Start a server, then two players (any machines that can reach it):
+
+    python scripts/room_server.py --port 3536
+    python examples/box_game_room.py --server 127.0.0.1:3536 --room demo
+    python examples/box_game_room.py --server 127.0.0.1:3536 --room demo
+
+Handles come from the sorted-peer-id convention (the first --players ids
+seat the game), so both processes derive the same assignment with no flags.  --relay forces the
+TURN-style data plane through the server.
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+from bevy_ggrs_tpu import (
+    GgrsRunner,
+    PlayerType,
+    RoomSocket,
+    SessionBuilder,
+    SessionState,
+    wait_for_players,
+)
+from bevy_ggrs_tpu.models import box_game
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--server", default="127.0.0.1:3536")
+    ap.add_argument("--room", default="demo")
+    ap.add_argument("--players", type=int, default=2)
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--relay", action="store_true",
+                    help="force the relayed data plane")
+    ap.add_argument("--peer-id", default=None)
+    args = ap.parse_args()
+
+    ip, port = args.server.rsplit(":", 1)
+    sock = RoomSocket(
+        (ip, int(port)), args.room, peer_id=args.peer_id,
+        mode="relay" if args.relay else "direct",
+    )
+    print(f"joined room '{args.room}' as {sock.peer_id}; waiting for "
+          f"{args.players} players...", flush=True)
+    wait_for_players(sock, args.players, timeout_s=60.0)
+    # the game seats exactly --players: the FIRST n sorted peer ids play
+    # (deterministic on every peer); later arrivals are spectator-less
+    # bystanders and must bail out rather than derive an out-of-range handle
+    players = sock.players()[: args.players]
+    handles = dict(enumerate(players))
+    if sock.peer_id not in players:
+        print(f"room already seated {args.players} players "
+              f"({players}); {sock.peer_id} is not among them — exiting",
+              flush=True)
+        sock.close()
+        sys.exit(1)
+    print(f"room full: {players}; handles: {handles}", flush=True)
+
+    app = box_game.make_app(num_players=args.players)
+    b = SessionBuilder.for_app(app).with_input_delay(2)
+    my_handle = None
+    for h, peer in handles.items():
+        if peer == sock.peer_id:
+            b.add_player(PlayerType.LOCAL, h)
+            my_handle = h
+        else:
+            b.add_player(PlayerType.REMOTE, h, peer)
+    session = b.start_p2p_session(sock)
+
+    key = ["right", "down", "left", "up"][my_handle % 4]
+
+    def read_inputs(hs):
+        return {h: box_game.keys_to_input(**{key: True}) for h in hs}
+
+    runner = GgrsRunner(app, session, read_inputs=read_inputs,
+                        on_event=lambda e: print(f"event: {e}", flush=True))
+
+    last = time.monotonic()
+    while session.current_state() != SessionState.RUNNING:
+        runner.update(0.0)
+        time.sleep(0.002)
+    print("synchronized; playing", flush=True)
+    while runner.frame < args.frames:
+        now = time.monotonic()
+        runner.update(now - last)
+        last = now
+        time.sleep(0.001)
+    print(f"done at frame {runner.frame}; checksum {runner.checksum:#018x}",
+          flush=True)
+    sock.close()
+
+
+if __name__ == "__main__":
+    main()

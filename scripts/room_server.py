@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Standalone room/signaling server (the matchbox `matchbox_server`
+analog): hosts rooms, pushes rosters, relays datagrams for peers that
+cannot reach each other directly.
+
+    python scripts/room_server.py --port 3536
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.session.room import RoomServer
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=3536)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="member silence timeout (s)")
+    args = ap.parse_args()
+    server = RoomServer(port=args.port, host=args.host,
+                        member_timeout_s=args.timeout)
+    print(f"room server on {server.local_addr}", flush=True)
+    last_report = 0.0
+    try:
+        while True:
+            server.poll()
+            now = time.monotonic()
+            if now - last_report >= 5.0:
+                last_report = now
+                rooms = {
+                    room: sorted(members)
+                    for room, members in server.rooms.items()
+                }
+                if rooms:
+                    print(f"rooms: {rooms}", flush=True)
+            time.sleep(0.002)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -135,3 +135,46 @@ def test_spectator_cli_follows_host_pair():
     assert spec.returncode == 0, s_out[-2000:]
     assert "frame" in s_out
     assert host.returncode == 0, h_out[-2000:]
+
+
+def test_box_game_room_example_pair():
+    """Matchmaking flow end-to-end: room server process + two player
+    processes that find each other by room name and finish with the SAME
+    checksum (printed on the final line)."""
+    import socket as so
+    import re
+
+    s = so.socket(so.AF_INET, so.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, BGT_PLATFORM="cpu")
+    server = subprocess.Popen(
+        [sys.executable, "scripts/room_server.py", "--port", str(port),
+         "--host", "127.0.0.1"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    procs = []
+    outs = []
+    try:
+        for name in ("alice", "bob"):
+            procs.append(subprocess.Popen(
+                [sys.executable, "examples/box_game_room.py",
+                 "--server", f"127.0.0.1:{port}", "--room", "smoke",
+                 "--frames", "90", "--peer-id", name],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            ))
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.kill()
+    sums = [re.search(r"checksum (0x[0-9a-f]+)", o) for o in outs]
+    assert all(sums), outs[0][-500:]
+    assert sums[0].group(1) == sums[1].group(1)
