@@ -134,6 +134,23 @@ class InputQueue:
         out.sort(key=lambda t: t[0])
         return out
 
+    def truncate_after(self, frame: int) -> None:
+        """Discard real inputs newer than ``frame`` and pull the contiguity
+        mark back to it — the disconnect-frame consensus adoption: frames
+        past the agreed point must resimulate under the disconnect policy
+        even if we received more of the stream than other survivors did."""
+        for g in [g for g in self._inputs if frame_gt(g, frame)]:
+            del self._inputs[g]
+        if self.last_confirmed != NULL_FRAME and frame_gt(
+            self.last_confirmed, frame
+        ):
+            self.last_confirmed = (
+                frame
+                if frame != NULL_FRAME and frame in self._inputs
+                else NULL_FRAME
+            )
+            self._recheck_contig()
+
     def gc(self, before_frame: int) -> None:
         """Drop inputs/predictions older than ``before_frame``."""
         for d in (self._inputs, self._predictions):

@@ -30,6 +30,7 @@ from ..utils.frames import (
 from .events import (
     DesyncDetected,
     DesyncDetection,
+    Disconnected,
     InputStatus,
     InvalidRequestError,
     NetworkStats,
@@ -40,7 +41,7 @@ from .events import (
     SessionState,
 )
 from .input_queue import InputQueue
-from .protocol import PeerEndpoint
+from .protocol import PeerEndpoint, now_s
 from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 
 
@@ -51,6 +52,9 @@ from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
 # that timeout and grow the history without bound.  Oldest frames drop first;
 # a peer that far behind has lost the stream anyway.
 MAX_UNACKED_FRAMES = 4096
+# how long an adopted disconnect-consensus frame keeps rebroadcasting
+# (notices ride lossy transports; receipt is idempotent under the min rule)
+DISC_NOTICE_REBROADCAST_S = 1.5
 
 
 def _min_ack(endpoints):
@@ -100,6 +104,14 @@ class P2PSession:
         self.events_buf: List = []
         self._staged: Dict[int, np.ndarray] = {}
         self._disc_corrected: set = set()  # addrs whose disconnect was resolved
+        # disconnect-frame consensus (GGPO-style): handle -> last frame whose
+        # REAL input stays in the sim; later frames bake DISCONNECTED/zero.
+        # Adopted as the MINIMUM of local knowledge and every received
+        # T_DISC_NOTICE so all survivors bake identical inputs for the dead
+        # player.  _disc_notices rebroadcasts our adopted value for a short
+        # window (notices ride lossy transports).
+        self._disc_frame: Dict[int, int] = {}
+        self._disc_notices: Dict[int, tuple] = {}  # handle -> (frame, until)
 
         self.local_handles: List[int] = []
         self.remote_handle_addr: Dict[int, Any] = {}
@@ -146,6 +158,7 @@ class P2PSession:
             ep.on_input = self._make_on_input(addr)
             ep.on_checksum = self._make_on_checksum(addr)
             ep.on_stream_base = self._make_on_stream_base(addr)
+            ep.on_disc_notice = self._make_on_disc_notice(addr)
             self.endpoints[addr] = ep
         # spectator endpoints: we stream all-player confirmed inputs to them
         self.spectator_endpoints: Dict[Any, PeerEndpoint] = {}
@@ -234,6 +247,16 @@ class P2PSession:
             if ep.disconnected and addr not in self._disc_corrected:
                 self._disc_corrected.add(addr)
                 self._force_disconnect_correction(addr)
+        if self._disc_notices:
+            now = now_s()
+            for h in list(self._disc_notices):
+                f, until = self._disc_notices[h]
+                if now >= until:
+                    del self._disc_notices[h]
+                    continue
+                for ep in self.endpoints.values():
+                    if not ep.disconnected and ep.state == SessionState.RUNNING:
+                        ep.send_disc_notice(h, f)
         # retransmit un-acked local inputs + acks
         for ep in self.endpoints.values():
             if ep.state == SessionState.RUNNING and not ep.disconnected:
@@ -377,7 +400,17 @@ class P2PSession:
                 h in self.remote_handle_addr
                 and self.endpoints[self.remote_handle_addr[h]].disconnected
             ):
-                status[h] = InputStatus.DISCONNECTED
+                # frames at or before the disconnect-consensus frame keep
+                # their REAL confirmed input (a deep rollback spanning
+                # pre-disconnect frames must reproduce the original sim —
+                # zeroing them would desync the survivor from its own
+                # ring); only frames past it bake the disconnect policy
+                v = self.queues[h].confirmed_input(frame)
+                if v is not None:
+                    inputs[h] = v
+                    status[h] = InputStatus.CONFIRMED
+                else:
+                    status[h] = InputStatus.DISCONNECTED
                 continue
             value, st = self.queues[h].input_for(frame)
             inputs[h] = value
@@ -387,47 +420,70 @@ class P2PSession:
     def _force_disconnect_correction(self, addr) -> None:
         """A remote endpoint just hit the disconnect timeout: frames advanced
         with served predictions for its handles will never be corrected by
-        the wire (its packets are dropped from here on), yet ``_inputs_for``
-        now reports DISCONNECTED/zero inputs for those handles.  Force the
-        mismatch-rollback NOW so resimulation bakes the disconnect policy in,
-        instead of leaving stale guesses live while ``_compute_confirmed``
-        (which skips disconnected remotes) leapfrogs past them — the
-        confirmed frame must never pass an uncorrected prediction (cf. the
-        pending-misprediction clamp in ``advance_frame``)."""
+        the wire (its packets are dropped from here on).  Adopt OUR last
+        real frame as the disconnect-consensus frame for each of its
+        handles (forcing the rollback that bakes the disconnect policy in
+        BEFORE ``_compute_confirmed`` — which skips disconnected remotes —
+        can leapfrog the uncorrected predictions), and announce it so every
+        survivor converges on the same frame."""
         for h in self._handle_of_addr.get(addr, []):
-            q = self.queues[h]
-            if q._base is None and q.last_confirmed == NULL_FRAME:
-                # nothing of this stream ever arrived: every served
-                # prediction was the default input — exactly the value the
-                # disconnect policy substitutes — and with no base we cannot
-                # tell pre-stream frames apart.  A status-only rollback here
-                # would *create* divergence against peers that saw more of
-                # the stream, so leave the predictions baked in.
-                continue
-            # predictions at or below the contiguity mark are already
-            # validated — and pre-stream-base predictions (frame 0 with
-            # input delay) are permanently correct: the served default IS
-            # the input on every peer, so correcting them to DISCONNECTED
-            # would *create* divergence
-            pending = [
-                f for f in q._predictions
-                if frame_lt(f, self.current_frame)
-                and (
-                    q.last_confirmed == NULL_FRAME
-                    or frame_gt(f, q.last_confirmed)
-                )
-                and (q._base is None or frame_ge(f, q._base))
-            ]
-            if not pending:
-                continue
-            first = pending[0]
-            for f in pending[1:]:
-                if frame_lt(f, first):
-                    first = f
-            if q.first_incorrect == NULL_FRAME or frame_lt(
-                first, q.first_incorrect
-            ):
-                q.first_incorrect = first
+            self._adopt_disconnect(h, self.queues[h].last_confirmed)
+
+    def _adopt_disconnect(self, handle: int, frame: int) -> None:
+        """Adopt a disconnect-consensus frame for ``handle`` (GGPO-style
+        min rule): keep real inputs up to ``frame``, resimulate everything
+        after it as DISCONNECTED/zero, and rebroadcast the adopted value.
+
+        The adoption is clamped to our confirmed frame: frames at or below
+        it may already be pruned from the snapshot ring, so a notice
+        reaching further back than that cannot be honored — the residual
+        divergence (the announcer never received an input we already
+        finalized) is the classic disconnect race; desync detection is the
+        backstop, and the min-rule plus prompt notices make it vanishingly
+        rare in practice (survivors stall within one prediction window of
+        the dead peer's stream, so their knowledge differs by at most the
+        frames in flight)."""
+        q = self.queues[handle]
+        f = frame_min(frame, q.last_confirmed)
+        if self._confirmed != NULL_FRAME and frame_lt(f, self._confirmed):
+            f = self._confirmed
+        cur = self._disc_frame.get(handle)
+        if cur is not None and frame_ge(f, cur):
+            return  # min rule: only ever adopt downward
+        self._disc_frame[handle] = f
+        q.truncate_after(f)
+        nxt = frame_add(f, 1)
+        if frame_lt(nxt, self.current_frame) and (
+            q.first_incorrect == NULL_FRAME
+            or frame_lt(nxt, q.first_incorrect)
+        ):
+            # frames after f were advanced on richer inputs (or stale
+            # predictions): the standard mismatch-rollback path replays
+            # them under the disconnect policy
+            q.first_incorrect = nxt
+        self._disc_notices[handle] = (f, now_s() + DISC_NOTICE_REBROADCAST_S)
+
+    def _make_on_disc_notice(self, addr):
+        def cb(handle: int, frame: int) -> None:
+            dead_addr = self.remote_handle_addr.get(handle)
+            if dead_addr is None or dead_addr == addr:
+                return  # our own handle, unknown, or a peer announcing itself
+            ep = self.endpoints[dead_addr]
+            if not ep.disconnected:
+                # consistency over liveness (GGPO): a peer the others
+                # dropped is dropped here too, immediately — otherwise we
+                # would keep confirming inputs the survivors will never see
+                ep.disconnected = True
+                ep.events.append(Disconnected(dead_addr))
+                self._disc_corrected.add(dead_addr)
+                # adopt EVERY handle of the dead peer from local knowledge
+                # first: the notice names one handle, but a multi-handle
+                # peer's other streams need their correction even if the
+                # announcer's per-handle notices never arrive
+                self._force_disconnect_correction(dead_addr)
+            self._adopt_disconnect(handle, frame)
+
+        return cb
 
     def _compute_confirmed(self) -> int:
         c = self.current_frame

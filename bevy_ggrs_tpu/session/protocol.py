@@ -22,6 +22,9 @@ in native/ggrs_core — keep in sync with message.h):
     QUAL_REP   pong_ts_us:u64
     KEEP_ALIVE (empty)
     CHECKSUM   frame:i32 checksum:u64
+    DISC_NOTICE handle:i16 frame:i32  (disconnect-frame consensus; peers
+               lacking the message type — e.g. the C++ core — ignore it
+               and keep local-knowledge disconnect semantics)
 """
 
 from __future__ import annotations
@@ -55,6 +58,12 @@ T_QUAL_REQ = 5
 T_QUAL_REP = 6
 T_KEEP_ALIVE = 7
 T_CHECKSUM = 8
+# disconnect-frame consensus (GGPO-style): when a peer drops a player, it
+# announces the last frame it holds a REAL input for; every survivor adopts
+# the MINIMUM announced frame so they all bake identical inputs for the dead
+# player (without this, survivors that received different amounts of the
+# dying peer's stream diverge permanently)
+T_DISC_NOTICE = 9
 
 S_SYNC_REQ = struct.Struct("<I")
 S_SYNC_REP = struct.Struct("<I")
@@ -63,6 +72,7 @@ S_INPUT_ACK = struct.Struct("<i")
 S_QUAL_REQ = struct.Struct("<Qb")
 S_QUAL_REP = struct.Struct("<Q")
 S_CHECKSUM = struct.Struct("<iQ")
+S_DISC_NOTICE = struct.Struct("<hi")  # (player handle, disconnect frame)
 
 NUM_SYNC_ROUNDTRIPS = 5
 SYNC_RETRY_S = 0.06
@@ -133,6 +143,7 @@ class PeerEndpoint:
         self.on_input: Optional[Callable[[int, bytes], None]] = None
         self.on_stream_base: Optional[Callable[[int], None]] = None
         self.on_checksum: Optional[Callable[[int, int], None]] = None
+        self.on_disc_notice: Optional[Callable[[int, int], None]] = None
         self.local_advantage = 0  # set by session before poll
         # stats
         self.ping_s = 0.0
@@ -176,6 +187,9 @@ class PeerEndpoint:
 
     def send_checksum(self, frame: int, checksum: int) -> None:
         self._send(T_CHECKSUM, S_CHECKSUM.pack(frame, checksum & (2**64 - 1)))
+
+    def send_disc_notice(self, handle: int, frame: int) -> None:
+        self._send(T_DISC_NOTICE, S_DISC_NOTICE.pack(handle, frame))
 
     # -- receiving ----------------------------------------------------------
 
@@ -278,6 +292,10 @@ class PeerEndpoint:
             frame, checksum = S_CHECKSUM.unpack_from(body)
             if self.on_checksum:
                 self.on_checksum(frame, checksum)
+        elif t == T_DISC_NOTICE:
+            handle, frame = S_DISC_NOTICE.unpack_from(body)
+            if self.on_disc_notice:
+                self.on_disc_notice(handle, frame)
         # T_KEEP_ALIVE: recv timestamp update is enough
 
     def _note_ack(self, ack: int) -> None:

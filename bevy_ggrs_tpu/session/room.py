@@ -146,6 +146,14 @@ class RoomServer:
             room, peer = r.s(), r.s()
             if not r.ok or not room or not peer:
                 return
+            # destination capacity FIRST: a rejected move must leave the
+            # old membership intact (dropping it before the check would
+            # deregister the socket entirely on a full destination)
+            members = self.rooms.setdefault(room, {})
+            if peer not in members and len(members) >= MAX_ROOM_MEMBERS:
+                if not members:
+                    del self.rooms[room]
+                return  # room full: drop the join (bounds the roster byte)
             # one socket = one membership: a JOIN from an addr already
             # registered elsewhere moves it (otherwise _prune on the stale
             # membership would pop the LIVE _addr_index entry and the
@@ -153,9 +161,7 @@ class RoomServer:
             prev = self._addr_index.get(addr)
             if prev is not None and prev != (room, peer):
                 self._drop_member(*prev, broadcast=True)
-            members = self.rooms.setdefault(room, {})
-            if peer not in members and len(members) >= MAX_ROOM_MEMBERS:
-                return  # room full: drop the join (bounds the roster byte)
+                members = self.rooms.setdefault(room, {})
             old = members.get(peer)
             if old is not None and old[0] != addr:
                 # same peer id re-joining from a new port: retire the old
@@ -315,7 +321,7 @@ class RoomSocket:
                 data, addr = self._sock.recvfrom(65536)
             except (BlockingIOError, OSError):
                 break
-            got = self._handle(data)
+            got = self._handle(data, addr)
             if got is not None:
                 out.append(got)
         now = time.monotonic()
@@ -329,7 +335,7 @@ class RoomSocket:
 
     # -- internals -----------------------------------------------------------
 
-    def _handle(self, data: bytes) -> Optional[Tuple[str, bytes]]:
+    def _handle(self, data: bytes, addr) -> Optional[Tuple[str, bytes]]:
         if len(data) < _HDR.size:
             return None
         magic, t = _HDR.unpack_from(data)
@@ -337,6 +343,8 @@ class RoomSocket:
             return None
         r = _Reader(data[_HDR.size:])
         if t == _ROSTER:
+            if addr != self.server_addr:
+                return None  # rosters are authoritative: server-origin only
             room = r.s()
             n = r.u8()
             if not r.ok or room != self.room:
@@ -351,11 +359,21 @@ class RoomSocket:
             self.roster = roster
             self._last_roster = time.monotonic()
             return None
-        if t == _FWD or t == _DATA:
+        if t == _FWD:
+            if addr != self.server_addr:
+                return None  # relayed data comes only from the server
             src = r.s()
             payload = r.rest()
             if not r.ok or not src:
                 return None
+            return (src, payload)
+        if t == _DATA:
+            src = r.s()
+            payload = r.rest()
+            if not r.ok or not src:
+                return None
+            if self.roster.get(src) != addr:
+                return None  # direct data must come from the roster addr
             return (src, payload)
         return None
 

@@ -1,0 +1,254 @@
+"""Disconnect-frame consensus (GGPO-style): when a peer dies mid-game, the
+survivors may have received DIFFERENT amounts of its input stream.  Each
+survivor announces the last real frame it holds (T_DISC_NOTICE) and all
+adopt the MINIMUM, truncating richer knowledge and resimulating everything
+past the consensus frame under the disconnect policy — so the survivors'
+simulations stay bit-identical after the death.  Also covers the
+_inputs_for fix: a deep rollback spanning PRE-disconnect frames must
+replay the dead player's real confirmed inputs, not zeros."""
+
+import time
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu import DesyncDetection
+from bevy_ggrs_tpu.session.events import DesyncDetected, Disconnected
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+from bevy_ggrs_tpu.utils.frames import NULL_FRAME
+
+DT = 1.0 / 60.0
+
+
+def _trio(seed, latency=1, loss=0.0, timeout=0.6):
+    net = ChannelNetwork(latency_hops=latency, loss=loss, seed=seed)
+    names = ["s0", "s1", "s2"]
+    socks = [net.endpoint(n) for n in names]
+    rngs = [np.random.default_rng(500 + 10 * seed + i) for i in range(3)]
+    runners = []
+    for i in range(3):
+        app = box_game.make_app(num_players=3)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .with_max_prediction_window(8)
+            .with_disconnect_timeout(timeout)
+            .with_disconnect_notify_delay(timeout / 3)
+            .with_desync_detection_mode(DesyncDetection.on(5))
+            .add_player(PlayerType.LOCAL, i)
+        )
+        for j in range(3):
+            if j != i:
+                b.add_player(PlayerType.REMOTE, j, names[j])
+        session = b.start_p2p_session(socks[i])
+
+        def read_inputs(handles, i=i):
+            return {h: np.uint8(rngs[i].integers(0, 16)) for h in handles}
+
+        runners.append(GgrsRunner(app, session, read_inputs=read_inputs))
+    return net, runners
+
+
+def _sync(net, runners, extra_timeout=20.0):
+    deadline = time.monotonic() + extra_timeout
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(
+            r.session.current_state() == SessionState.RUNNING for r in runners
+        ):
+            return True
+        time.sleep(0.001)
+    return False
+
+
+def _confirmed_agreement(survivors, net=None, drive=None):
+    """Newest mutually-held, mutually-confirmed ring frame must agree."""
+    for _ in range(60):
+        conf = min(r.session.confirmed_frame() for r in survivors)
+        shared = set(survivors[0].ring.frames())
+        for r in survivors[1:]:
+            shared &= set(r.ring.frames())
+        shared = [f for f in shared if f <= conf]
+        if shared:
+            f = max(shared)
+            cs = [checksum_to_int(r.ring.peek(f)[1]) for r in survivors]
+            return f, cs
+        if drive is not None:
+            drive()
+    return None, None
+
+
+@pytest.mark.parametrize("seed,kill_tick,loss", [
+    (1, 45, 0.0),
+    (2, 60, 0.1),
+    (3, 53, 0.2),
+])
+def test_survivors_converge_after_mid_game_death(seed, kill_tick, loss):
+    # timeout 0.6s: one jit-compile stall contributes at most timeout/2 to
+    # the attended-quiet clock, and the longer pre-kill phase compiles the
+    # deep-rollback program shapes while everyone is still alive — a 0.35s
+    # timeout was flaky under the compile storm that 20% loss provokes
+    net, runners = _trio(seed, latency=1, loss=loss)
+    assert _sync(net, runners)
+    # play with all three, then peer 2 dies abruptly (process-death analog:
+    # no LEAVE, packets just stop)
+    for t in range(kill_tick):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+        time.sleep(0.001)
+    survivors = runners[:2]
+    # survivors keep ticking; peer 2 is never updated again.  Real sleeps
+    # let the attended-quiet timeout (0.35 s) fire.
+    saw_disc = [False, False]
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        net.deliver()
+        for i, r in enumerate(survivors):
+            r.update(DT)
+            saw_disc[i] = saw_disc[i] or any(
+                isinstance(e, Disconnected) for e in r.events
+            )
+        if all(saw_disc):
+            break
+        time.sleep(0.004)
+    assert all(saw_disc), "survivors never dropped the dead peer"
+
+    # the consensus frame converged to the same value on both survivors
+    for _ in range(120):
+        net.deliver()
+        for r in survivors:
+            r.update(DT)
+        time.sleep(0.001)
+    cf = [r.session._disc_frame.get(2) for r in survivors]
+    assert cf[0] is not None and cf[0] == cf[1], cf
+
+    # both made clean progress past the death
+    assert all(r.frame >= kill_tick + 60 for r in survivors)
+
+    def drive():
+        net.deliver()
+        for r in survivors:
+            r.update(DT)
+
+    f, cs = _confirmed_agreement(survivors, drive=drive)
+    assert f is not None, "survivors share no confirmed frame"
+    assert cs[0] == cs[1], f"survivors desynced at frame {f}: {cs}"
+
+
+def test_notice_fast_propagates_disconnect():
+    """A survivor that learns of a death via T_DISC_NOTICE drops the dead
+    peer immediately (consistency over liveness) instead of waiting out its
+    own timeout — proven by giving survivor 1 a 30 s timer it never gets to
+    use: only the notice from survivor 0 (0.6 s timer) can be the trigger.
+    Both then hold the SAME consensus frame and stay checksum-identical."""
+    net, runners = _trio(seed=9, timeout=0.6)
+    assert _sync(net, runners)
+    s0, s1 = runners[0].session, runners[1].session
+    for ep in s1.endpoints.values():
+        ep.disconnect_timeout_s = 30.0  # s1 can only learn via the notice
+    for _ in range(20):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+        time.sleep(0.001)
+    # peer 2 dies for real (never updated again)
+    survivors = runners[:2]
+    t0 = time.monotonic()
+    deadline = t0 + 10.0
+    while time.monotonic() < deadline:
+        net.deliver()
+        for r in survivors:
+            r.update(DT)
+        if s1.endpoints["s2"].disconnected:
+            break
+        time.sleep(0.004)
+    took = time.monotonic() - t0
+    assert s1.endpoints["s2"].disconnected
+    assert took < 5.0  # via notice, not a 30 s timeout
+    for _ in range(60):
+        net.deliver()
+        for r in survivors:
+            r.update(DT)
+        time.sleep(0.001)
+    assert s1._disc_frame.get(2) is not None
+    assert s1._disc_frame.get(2) == s0._disc_frame.get(2)
+
+    def drive():
+        net.deliver()
+        for r in survivors:
+            r.update(DT)
+
+    f, cs = _confirmed_agreement(survivors, drive=drive)
+    assert f is not None
+    assert cs[0] == cs[1], f"survivors desynced at frame {f}: {cs}"
+
+
+def test_deep_rollback_replays_real_inputs_of_dead_peer():
+    """_inputs_for regression: after a disconnect, frames AT OR BEFORE the
+    consensus frame must resimulate with the dead player's real confirmed
+    inputs — a rollback spanning them used to zero them out and desync the
+    survivor from its own ring."""
+    net, runners = _trio(seed=5, latency=2)
+    assert _sync(net, runners)
+    for _ in range(30):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+        time.sleep(0.001)
+    s0 = runners[0].session
+    cf = s0._disc_frame.get(2, None)
+    assert cf is None  # nobody dead yet
+    # record what the sim used for a confirmed frame of peer 2
+    probe = s0.queues[2].last_confirmed
+    assert probe != NULL_FRAME
+    real = np.array(s0.queues[2].confirmed_input(probe), copy=True)
+    # peer 2 dies; survivor adopts
+    s0.endpoints["s2"].disconnected = True
+    s0.poll_remote_clients()
+    adopted = s0._disc_frame.get(2)
+    assert adopted is not None
+    # pre-consensus frames: real input, CONFIRMED status
+    if probe <= adopted:
+        inputs, status = s0._inputs_for(probe)
+        assert np.array_equal(inputs[2], real)
+        from bevy_ggrs_tpu.session.events import InputStatus
+
+        assert status[2] == InputStatus.CONFIRMED
+    # post-consensus frames: zeros, DISCONNECTED status
+    inputs, status = s0._inputs_for(adopted + 3)
+    from bevy_ggrs_tpu.session.events import InputStatus
+
+    assert status[2] == InputStatus.DISCONNECTED
+    assert not np.any(inputs[2])
+
+
+def test_notice_adopts_all_handles_of_multi_handle_peer():
+    """A T_DISC_NOTICE names ONE handle, but the dead peer may own several:
+    marking it disconnected must adopt a consensus frame for EVERY handle
+    from local knowledge (the announcer's notices for the other handles may
+    be lost within their rebroadcast window)."""
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    net = ChannelNetwork()
+    app = box_game.make_app(num_players=4)
+    b = (
+        SessionBuilder.for_app(app)
+        .with_input_delay(1)
+        .add_player(PlayerType.LOCAL, 0)
+        .add_player(PlayerType.REMOTE, 1, "X")  # X owns handles 1 AND 2
+        .add_player(PlayerType.REMOTE, 2, "X")
+        .add_player(PlayerType.REMOTE, 3, "Y")
+    )
+    s = b.start_p2p_session(net.endpoint("me"))
+    cb = s._make_on_disc_notice("Y")  # announcer is the OTHER peer
+    cb(1, 5)  # notice about one of X's handles only
+    assert s.endpoints["X"].disconnected
+    assert 1 in s._disc_frame
+    assert 2 in s._disc_frame  # the un-noticed handle adopted too
+    assert not s.endpoints["Y"].disconnected

@@ -241,3 +241,80 @@ def test_room_member_cap_and_socket_move():
     assert sorted(server.rooms["second"]) == ["mover"]
     server.close()
     a.close()
+
+
+def test_forged_control_packets_are_ignored():
+    """Source-address validation: rosters/relays must come from the server,
+    direct data from the roster address — a forged ROSTER would otherwise
+    hijack the data plane wholesale."""
+    import socket as so
+    import struct as st
+
+    from bevy_ggrs_tpu.session.room import ROOM_MAGIC, _HDR, _pack_str
+
+    server, socks = _room_pair("direct", room="spoof")
+    atk = so.socket(so.AF_INET, so.SOCK_DGRAM)
+    atk.bind(("127.0.0.1", 0))
+    # forged roster pointing peer-1 at the attacker
+    evil = (_HDR.pack(ROOM_MAGIC, 2) + _pack_str("spoof") + bytes([1])
+            + _pack_str("peer-1") + _pack_str("127.0.0.1")
+            + st.pack("<H", atk.getsockname()[1]))
+    atk.sendto(evil, socks[0].local_addr)
+    time.sleep(0.05)
+    before = dict(socks[0].roster)
+    socks[0].receive_all()
+    assert socks[0].roster == before  # forged roster rejected
+    # forged direct DATA claiming to be peer-1 from the attacker's addr
+    fake = _HDR.pack(ROOM_MAGIC, 3) + _pack_str("peer-1") + b"evil"
+    atk.sendto(fake, socks[0].local_addr)
+    time.sleep(0.05)
+    got = socks[0].receive_all()
+    assert ("peer-1", b"evil") not in got
+    # forged FWD not from the server: also dropped
+    fwd = _HDR.pack(ROOM_MAGIC, 5) + _pack_str("peer-1") + b"evil2"
+    atk.sendto(fwd, socks[0].local_addr)
+    time.sleep(0.05)
+    got = socks[0].receive_all()
+    assert all(payload != b"evil2" for _, payload in got)
+    # the legit plane still works
+    socks[1].send_to(b"legit", "peer-0")
+    got = []
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and not got:
+        server.poll()
+        got = socks[0].receive_all()
+        time.sleep(0.002)
+    assert got == [("peer-1", b"legit")]
+    atk.close()
+    server.close()
+    for s in socks:
+        s.close()
+
+
+def test_move_to_full_room_keeps_old_membership():
+    """A JOIN rejected for capacity must not deregister the mover from its
+    previous room."""
+    from bevy_ggrs_tpu.session import room as room_mod
+
+    old_cap = room_mod.MAX_ROOM_MEMBERS
+    room_mod.MAX_ROOM_MEMBERS = 1
+    try:
+        server = RoomServer(host="127.0.0.1")
+        addr = server.local_addr
+        a = RoomSocket(addr, "origin", peer_id="mover", host="127.0.0.1")
+        blocker = RoomSocket(addr, "fullroom", peer_id="resident",
+                             host="127.0.0.1")
+        wait_for_players(a, 1, timeout_s=5.0, server=server)
+        wait_for_players(blocker, 1, timeout_s=5.0, server=server)
+        a.room = "fullroom"
+        a._join()
+        for _ in range(20):
+            server.poll()
+            time.sleep(0.005)
+        assert sorted(server.rooms["fullroom"]) == ["resident"]
+        assert sorted(server.rooms["origin"]) == ["mover"]  # still seated
+        server.close()
+        a.close()
+        blocker.close()
+    finally:
+        room_mod.MAX_ROOM_MEMBERS = old_cap
