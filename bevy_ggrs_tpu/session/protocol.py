@@ -22,9 +22,9 @@ in native/ggrs_core — keep in sync with message.h):
     QUAL_REP   pong_ts_us:u64
     KEEP_ALIVE (empty)
     CHECKSUM   frame:i32 checksum:u64
-    DISC_NOTICE handle:i16 frame:i32  (disconnect-frame consensus; peers
-               lacking the message type — e.g. the C++ core — ignore it
-               and keep local-knowledge disconnect semantics)
+    DISC_NOTICE handle:i16 frame:i32  (disconnect-frame consensus,
+               implemented by BOTH cores; peers lacking the message type
+               ignore it and keep local-knowledge disconnect semantics)
 """
 
 from __future__ import annotations

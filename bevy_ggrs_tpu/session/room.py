@@ -151,8 +151,6 @@ class RoomServer:
             # deregister the socket entirely on a full destination)
             members = self.rooms.setdefault(room, {})
             if peer not in members and len(members) >= MAX_ROOM_MEMBERS:
-                if not members:
-                    del self.rooms[room]
                 return  # room full: drop the join (bounds the roster byte)
             # one socket = one membership: a JOIN from an addr already
             # registered elsewhere moves it (otherwise _prune on the stale
@@ -269,7 +267,11 @@ class RoomSocket:
                  port: int = 0, host: str = "0.0.0.0"):
         if mode not in ("direct", "relay"):
             raise ValueError("mode must be 'direct' or 'relay'")
-        self.server_addr = server_addr
+        # resolve once: inbound packets are validated against the source
+        # address recvfrom() reports, which is always a numeric IP — a
+        # hostname here would never match and all rosters would be dropped
+        sip, sport = server_addr
+        self.server_addr = (_socket.gethostbyname(sip), int(sport))
         self.room = room
         self.peer_id = peer_id or uuid.uuid4().hex[:12]
         self.mode = mode

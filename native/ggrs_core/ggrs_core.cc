@@ -42,7 +42,10 @@ static double now_s() {
 constexpr uint16_t MAGIC = 0x47A7;
 constexpr uint8_t T_SYNC_REQ = 1, T_SYNC_REP = 2, T_INPUT = 3, T_INPUT_ACK = 4,
                   T_QUAL_REQ = 5, T_QUAL_REP = 6, T_KEEP_ALIVE = 7,
-                  T_CHECKSUM = 8;
+                  T_CHECKSUM = 8, T_DISC_NOTICE = 9;
+/* how long an adopted disconnect-consensus frame keeps rebroadcasting
+ * (mirrors session/p2p.py DISC_NOTICE_REBROADCAST_S) */
+constexpr double DISC_NOTICE_REBROADCAST_S = 1.5;
 constexpr int NUM_SYNC_ROUNDTRIPS = 5;
 constexpr double SYNC_RETRY_S = 0.06, QUALITY_INTERVAL_S = 0.2,
                  KEEP_ALIVE_S = 0.2;
@@ -252,6 +255,15 @@ struct Endpoint {
     Writer b; b.i32(f); b.u64(cs); send(T_CHECKSUM, b);
   }
 
+  std::deque<std::pair<int, Frame>> disc_notice_inbox;
+
+  void send_disc_notice(int handle, Frame frame) {
+    Writer b;
+    b.u16((uint16_t)(int16_t)handle);
+    b.i32(frame);
+    send(T_DISC_NOTICE, b);
+  }
+
   void note_ack(Frame ack) {
     if (ack != NULL_FRAME && (last_acked == NULL_FRAME || frame_gt(ack, last_acked)))
       last_acked = ack;
@@ -353,6 +365,13 @@ struct Endpoint {
         uint64_t cs = r.u64();
         if (!r.ok) break;
         checksum_inbox.emplace_back(f, cs);
+        break;
+      }
+      case T_DISC_NOTICE: {
+        int handle = (int)(int16_t)r.u16();
+        Frame f = r.i32();
+        if (!r.ok) break;
+        disc_notice_inbox.push_back({handle, f});
         break;
       }
       default: break; /* keepalive: recv timestamp update is enough */
@@ -480,6 +499,18 @@ struct InputQueue {
     return f;
   }
 
+  /* disconnect-frame consensus adoption: drop real inputs newer than f and
+   * pull the contiguity mark back (mirrors InputQueue.truncate_after) */
+  void truncate_after(Frame f) {
+    for (auto it = inputs.begin(); it != inputs.end();)
+      it = frame_gt(it->first, f) ? inputs.erase(it) : std::next(it);
+    if (last_confirmed != NULL_FRAME && frame_gt(last_confirmed, f)) {
+      last_confirmed =
+          (f != NULL_FRAME && inputs.count(f)) ? f : NULL_FRAME;
+      recheck_contig();
+    }
+  }
+
   void gc(Frame before) {
     for (auto *m : {&inputs, &predictions})
       for (auto it = m->begin(); it != m->end();)
@@ -508,6 +539,10 @@ struct GgrsP2P {
   Frame next_spectator_frame = 0;
   std::vector<InputQueue> queues;
   std::vector<Addr> disc_corrected; /* addrs whose disconnect was resolved */
+  /* disconnect-frame consensus (mirrors session/p2p.py _disc_frame /
+   * _disc_notices): handle -> adopted frame; handle -> (frame, until) */
+  std::map<int, Frame> disc_frame;
+  std::map<int, std::pair<Frame, double>> disc_notices;
   std::map<int, std::vector<uint8_t>> staged;
   std::deque<std::pair<Frame, std::vector<uint8_t>>> local_sent;
   std::deque<Event> events;
@@ -605,6 +640,29 @@ int ggrs_p2p_state(GgrsP2P *s) {
   return GGRS_RUNNING;
 }
 
+/* GGPO-style min-rule adoption (mirrors P2PSession._adopt_disconnect):
+ * keep real inputs up to the consensus frame, resim the tail under the
+ * disconnect policy, rebroadcast.  Clamped at our confirmed frame (frames
+ * below it may be pruned from the driver's ring); the residual race when a
+ * survivor confirmed a frame another never received is caught by desync
+ * detection. */
+static void adopt_disconnect(GgrsP2P *s, int handle, Frame frame) {
+  auto &q = s->queues[handle];
+  Frame f = frame_le(frame, q.last_confirmed) ? frame : q.last_confirmed;
+  if (s->confirmed != NULL_FRAME && frame_lt(f, s->confirmed))
+    f = s->confirmed;
+  auto it = s->disc_frame.find(handle);
+  if (it != s->disc_frame.end() && !frame_lt(f, it->second)) return;
+  s->disc_frame[handle] = f;
+  q.truncate_after(f);
+  Frame nxt = f + 1;
+  if (frame_lt(nxt, s->current_frame) &&
+      (q.first_incorrect == NULL_FRAME ||
+       frame_lt(nxt, q.first_incorrect)))
+    q.first_incorrect = nxt;
+  s->disc_notices[handle] = {f, now_s() + DISC_NOTICE_REBROADCAST_S};
+}
+
 void ggrs_p2p_poll(GgrsP2P *s) {
   uint8_t buf[65536];
   Addr from;
@@ -634,6 +692,18 @@ void ggrs_p2p_poll(GgrsP2P *s) {
     /* drain endpoint state into the session */
     for (auto &e : ep->events) s->events.push_back(e);
     ep->events.clear();
+    /* an endpoint marked disconnected — possibly by a T_DISC_NOTICE
+     * processed EARLIER in this same poll — must not drain its inboxes
+     * into the queues: re-adding inputs past the just-adopted consensus
+     * frame would silently re-extend last_confirmed and desync us from
+     * the other survivors (the python core is immune because
+     * PeerEndpoint.handle() drops packets the instant the flag is set;
+     * here the recv loop filled the inbox before the notice ran) */
+    if (ep->disconnected) {
+      ep->have_base_inbox = false;
+      ep->inbox.clear();
+      ep->checksum_inbox.clear();
+    }
     if (ep->have_base_inbox) {
       ep->have_base_inbox = false;
       for (int h : s->handles_of_addr[addr])
@@ -654,6 +724,23 @@ void ggrs_p2p_poll(GgrsP2P *s) {
         s->events.push_back({GGRS_EV_DESYNC, f, remote_cs, addr, it->second});
     }
     ep->checksum_inbox.clear();
+    for (auto &[h, f] : ep->disc_notice_inbox) {
+      auto it2 = s->remote_handle_addr.find(h);
+      if (it2 == s->remote_handle_addr.end() || it2->second == addr)
+        continue; /* our handle, unknown, or a peer announcing itself */
+      auto &dep = s->endpoints[it2->second];
+      if (!dep->disconnected) {
+        /* consistency over liveness: fast-propagate the drop, adopting
+         * every handle of the dead peer from local knowledge first */
+        dep->disconnected = true;
+        dep->events.push_back({GGRS_EV_DISCONNECTED, 0, 0, it2->second});
+        s->disc_corrected.push_back(it2->second);
+        for (int hh : s->handles_of_addr[it2->second])
+          adopt_disconnect(s, hh, s->queues[hh].last_confirmed);
+      }
+      adopt_disconnect(s, h, f);
+    }
+    ep->disc_notice_inbox.clear();
     if (ep->state == GGRS_RUNNING && !ep->disconnected)
       ep->send_inputs(s->local_sent);
   }
@@ -671,25 +758,22 @@ void ggrs_p2p_poll(GgrsP2P *s) {
     for (auto &a : s->disc_corrected) seen |= (a == addr);
     if (seen) continue;
     s->disc_corrected.push_back(addr);
-    for (int h : s->handles_of_addr[addr]) {
-      auto &q = s->queues[h];
-      /* nothing of this stream ever arrived: served predictions were the
-       * default input (== the disconnect substitute) and pre-stream frames
-       * are indistinguishable — a status-only rollback would CREATE
-       * divergence against peers that saw more of the stream */
-      if (!q.have_base && q.last_confirmed == NULL_FRAME) continue;
-      Frame first = NULL_FRAME;
-      for (auto &[f, v] : q.predictions) {
-        if (!frame_lt(f, s->current_frame)) continue;
-        if (q.last_confirmed != NULL_FRAME && frame_le(f, q.last_confirmed))
-          continue;
-        if (q.have_base && frame_lt(f, q.base)) continue;
-        if (first == NULL_FRAME || frame_lt(f, first)) first = f;
+    for (int h : s->handles_of_addr[addr])
+      adopt_disconnect(s, h, s->queues[h].last_confirmed);
+  }
+  /* rebroadcast adopted consensus frames while their window is open
+   * (notices ride lossy links; receipt is idempotent under the min rule) */
+  if (!s->disc_notices.empty()) {
+    double now = now_s();
+    for (auto it = s->disc_notices.begin(); it != s->disc_notices.end();) {
+      if (now >= it->second.second) {
+        it = s->disc_notices.erase(it);
+        continue;
       }
-      if (first != NULL_FRAME &&
-          (q.first_incorrect == NULL_FRAME ||
-           frame_lt(first, q.first_incorrect)))
-        q.first_incorrect = first;
+      for (auto &[a2, ep2] : s->endpoints)
+        if (!ep2->disconnected && ep2->state == GGRS_RUNNING)
+          ep2->send_disc_notice(it->first, it->second.first);
+      ++it;
     }
   }
 }
@@ -777,8 +861,17 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
       int status;
       auto it = s->remote_handle_addr.find(h);
       if (it != s->remote_handle_addr.end() && s->endpoints[it->second]->disconnected) {
-        status = GGRS_INPUT_DISCONNECTED;
-        memset(input_buf + ib, 0, s->input_size);
+        /* frames at/below the consensus frame keep their REAL confirmed
+         * input (a deep rollback must reproduce the original sim); only
+         * frames past it bake the disconnect policy */
+        const std::vector<uint8_t> *v = s->queues[h].confirmed(f);
+        if (v != nullptr) {
+          memcpy(input_buf + ib, v->data(), s->input_size);
+          status = GGRS_INPUT_CONFIRMED;
+        } else {
+          status = GGRS_INPUT_DISCONNECTED;
+          memset(input_buf + ib, 0, s->input_size);
+        }
       } else {
         status = s->queues[h].input_for(f, input_buf + ib);
       }

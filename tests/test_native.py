@@ -339,3 +339,80 @@ def test_native_spectator_catchup():
     assert float(r_spec.world.comps["pos"][0, 0]) > 1.9
     sock0.close()
     sock1.close()
+
+
+def test_native_three_peer_disconnect_consensus():
+    """C++ core parity for the disconnect-frame consensus: a 3-peer native
+    full mesh loses one peer mid-game; both survivors drop it (one possibly
+    via the notice), keep advancing, and stay checksum-identical at
+    mutually confirmed ring frames."""
+    from bevy_ggrs_tpu import SessionBuilder as SB
+    from bevy_ggrs_tpu.session.events import Disconnected
+    from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+    ports = free_ports(3)
+    runners = []
+    for i in range(3):
+        app = box_game.make_app(num_players=3)
+        b = (
+            SB.for_app(app)
+            .with_input_delay(1)
+            .with_max_prediction_window(8)
+            .with_disconnect_timeout(0.6)
+            .with_disconnect_notify_delay(0.2)
+            .add_player(PlayerType.LOCAL, i)
+        )
+        for j in range(3):
+            if j != i:
+                b.add_player(PlayerType.REMOTE, j, ("127.0.0.1", ports[j]))
+        session = b.start_p2p_session_native(local_port=ports[i])
+        rng = np.random.default_rng(70 + i)
+        runners.append(GgrsRunner(
+            app, session,
+            read_inputs=lambda hs, r=rng: {
+                h: np.uint8(r.integers(0, 16)) for h in hs
+            },
+        ))
+    assert sync_all(runners)
+    for _ in range(60):
+        interleave(runners, 1)
+        time.sleep(0.001)
+    # peer 2 dies abruptly
+    survivors = runners[:2]
+    saw = [False, False]
+    deadline = time.monotonic() + 12.0
+    while time.monotonic() < deadline:
+        for i, r in enumerate(survivors):
+            r.update(DT)
+            saw[i] = saw[i] or any(
+                isinstance(e, Disconnected) for e in r.events
+            )
+        if all(saw):
+            break
+        time.sleep(0.004)
+    assert all(saw), "survivors never dropped the dead peer"
+    before = [r.frame for r in survivors]
+    for _ in range(150):
+        for r in survivors:
+            r.update(DT)
+        time.sleep(0.001)
+    assert all(
+        r.frame >= b + 100 for r, b in zip(survivors, before)
+    ), [r.frame for r in survivors]
+    f = None
+    for _ in range(60):
+        conf = min(r.session.confirmed_frame() for r in survivors)
+        shared = [
+            fr
+            for fr in set(survivors[0].ring.frames())
+            & set(survivors[1].ring.frames())
+            if fr <= conf
+        ]
+        if shared:
+            f = max(shared)
+            break
+        for r in survivors:
+            r.update(DT)
+    assert f is not None, "no mutually confirmed ring frame"
+    cs = [checksum_to_int(r.ring.peek(f)[1]) for r in survivors]
+    assert cs[0] == cs[1], f"native survivors desynced at frame {f}: {cs}"
