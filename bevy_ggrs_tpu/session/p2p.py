@@ -165,7 +165,8 @@ class P2PSession:
         for addr in self.spectator_addrs:
             ep = PeerEndpoint(
                 send=(lambda data, a=addr: self.socket.send_to(data, a)),
-                input_size=self.input_size * num_players,
+                # full row: all-player inputs + one status byte per player
+                input_size=self.input_size * num_players + num_players,
                 rng_nonce=rng.getrandbits(32),
                 disconnect_timeout_s=disconnect_timeout_s,
                 disconnect_notify_start_s=disconnect_notify_start_s,
@@ -519,12 +520,31 @@ class P2PSession:
         while frame_le(self._next_spectator_frame, self._confirmed):
             f = self._next_spectator_frame
             rows = []
+            stats = bytearray()
             for h in range(self._num_players):
                 v = self.queues[h].confirmed_input(f)
                 if v is None:
+                    # stream the status the HOST's sim actually used, so a
+                    # status-sensitive spectator replays bit-identically:
+                    # a dead player's post-consensus frames are
+                    # DISCONNECTED; pre-stream-base frames were advanced
+                    # on the PREDICTED default
+                    disc = (
+                        h in self.remote_handle_addr
+                        and self.endpoints[
+                            self.remote_handle_addr[h]
+                        ].disconnected
+                    )
+                    stats.append(
+                        int(InputStatus.DISCONNECTED)
+                        if disc
+                        else int(InputStatus.PREDICTED)
+                    )
                     v = self.queues[h].default_input()
+                else:
+                    stats.append(int(InputStatus.CONFIRMED))
                 rows.append(np.ascontiguousarray(v).tobytes())
-            self._spectator_sent.append((f, b"".join(rows)))
+            self._spectator_sent.append((f, b"".join(rows) + bytes(stats)))
             self._next_spectator_frame = frame_add(self._next_spectator_frame, 1)
         acked = _min_ack(self.spectator_endpoints.values())
         if acked is None:

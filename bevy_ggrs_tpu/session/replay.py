@@ -38,6 +38,10 @@ class InputRecorder:
         self.canonical_depth = canonical_depth
         self.canonical_branches = canonical_branches
         self.frames: Dict[int, np.ndarray] = {}
+        # per-frame statuses the sim ACTUALLY used (a dead player's
+        # post-consensus frames are DISCONNECTED; replays of
+        # status-sensitive models must reproduce that, not all-CONFIRMED)
+        self.statuses: Dict[int, np.ndarray] = {}
         self._all_confirmed: Set[int] = set()
         self._watermark: int = NULL_FRAME  # session confirmed frame
 
@@ -54,6 +58,7 @@ class InputRecorder:
         overwrites, so by the time a frame is final the stored value is the
         confirmed truth."""
         self.frames[frame] = np.array(inputs, self.input_dtype)
+        self.statuses[frame] = np.array(status, np.int8)
         if np.all(status == InputStatus.CONFIRMED):
             self._all_confirmed.add(frame)
 
@@ -92,6 +97,15 @@ class InputRecorder:
             inputs=np.stack([final[k] for k in keys])
             if keys
             else np.zeros((0, self.num_players, *self.input_shape), self.input_dtype),
+            statuses=np.stack([
+                self.statuses.get(
+                    k, np.full((self.num_players,), InputStatus.CONFIRMED,
+                               np.int8)
+                )
+                for k in keys
+            ])
+            if keys
+            else np.zeros((0, self.num_players), np.int8),
             num_players=self.num_players,
             input_shape=np.array(self.input_shape, np.int64),
             input_dtype=str(self.input_dtype),
@@ -112,8 +126,11 @@ class InputRecorder:
             canonical_depth=None if cd < 0 else cd,
             canonical_branches=None if cb < 0 else cb,
         )
-        for f, row in zip(z["frames"], z["inputs"]):
+        stats = z["statuses"] if "statuses" in z else None
+        for i, (f, row) in enumerate(zip(z["frames"], z["inputs"])):
             rec.frames[int(f)] = row.astype(rec.input_dtype)
+            if stats is not None:
+                rec.statuses[int(f)] = stats[i].astype(np.int8)
             rec._all_confirmed.add(int(f))  # saved frames are final
         return rec
 
@@ -156,6 +173,9 @@ class ReplaySession:
         if self.current_frame not in self._frames:
             raise PredictionThresholdError()  # gap or end of recording
         inputs = self._frames[self.current_frame]
+        status = self.rec.statuses.get(
+            self.current_frame,
+            np.full((self.rec.num_players,), InputStatus.CONFIRMED, np.int8),
+        )
         self.current_frame = frame_add(self.current_frame, 1)
-        status = np.full((self.rec.num_players,), InputStatus.CONFIRMED, np.int8)
         return [AdvanceRequest(inputs, status)]

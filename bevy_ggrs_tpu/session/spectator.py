@@ -15,7 +15,6 @@ import numpy as np
 
 from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import (
-    InputStatus,
     NetworkStats,
     NotSynchronizedError,
     PredictionThresholdError,
@@ -49,10 +48,15 @@ class SpectatorSession:
         self.current_frame = 0
         self.catchup_speed = catchup_speed
         self.events_buf: List = []
-        self._inputs: Dict[int, np.ndarray] = {}  # frame -> [P, *shape]
+        # frame -> (inputs [P, *shape], statuses int8[P])
+        self._inputs: Dict[int, tuple] = {}
         self.endpoint = PeerEndpoint(
             send=lambda data: self.socket.send_to(data, host_addr),
-            input_size=self.input_size * num_players,
+            # full row: all-player inputs + one status byte per player (the
+            # host streams the statuses its own sim used, so
+            # status-sensitive models replay bit-identically — e.g.
+            # DISCONNECTED for a dead player's post-consensus frames)
+            input_size=self.input_size * num_players + num_players,
             rng_nonce=random.getrandbits(32),
             disconnect_timeout_s=disconnect_timeout_s,
             disconnect_notify_start_s=disconnect_notify_start_s,
@@ -61,9 +65,14 @@ class SpectatorSession:
         self.endpoint.on_input = self._on_input
 
     def _on_input(self, frame: int, raw: bytes) -> None:
-        self._inputs[frame] = np.frombuffer(raw, self.input_dtype).reshape(
+        n = self.input_size * self._num_players
+        inputs = np.frombuffer(raw[:n], self.input_dtype).reshape(
             (self._num_players, *self.input_shape)
         )
+        status = np.frombuffer(
+            raw[n:n + self._num_players], np.int8
+        ).copy()
+        self._inputs[frame] = (inputs, status)
 
     # -- GGRS session surface ----------------------------------------------
 
@@ -121,12 +130,11 @@ class SpectatorSession:
         n = 1
         if self.frames_behind_host() > 2:
             n += max(self.catchup_speed, 0)
-        status = np.full((self._num_players,), InputStatus.CONFIRMED, np.int8)
         requests: List = []
         for _ in range(n):
             if self.current_frame not in self._inputs:
                 break
-            inputs = self._inputs.pop(self.current_frame)
+            inputs, status = self._inputs.pop(self.current_frame)
             self.current_frame = frame_add(self.current_frame, 1)
             requests.append(AdvanceRequest(np.asarray(inputs), status))
         return requests

@@ -621,7 +621,7 @@ int ggrs_p2p_start(GgrsP2P *s) {
     auto ep = std::make_unique<Endpoint>();
     ep->addr = addr;
     ep->sock = &s->sock;
-    ep->input_size = s->input_size * s->num_players; /* full-row stream */
+    ep->input_size = s->input_size * s->num_players + s->num_players; /* inputs + status bytes */
     ep->sync_nonce = s->rng();
     ep->disconnect_timeout_s = s->disconnect_timeout_s;
     ep->disconnect_notify_s = s->disconnect_notify_s;
@@ -942,12 +942,27 @@ int ggrs_p2p_advance(GgrsP2P *s, int32_t *req_buf, int req_cap,
     while (frame_le(s->next_spectator_frame, s->confirmed)) {
       Frame f = s->next_spectator_frame;
       std::vector<uint8_t> row;
-      row.reserve((size_t)s->num_players * s->input_size);
+      row.reserve((size_t)s->num_players * (s->input_size + 1));
+      std::vector<uint8_t> stats;
+      stats.reserve(s->num_players);
       for (int h = 0; h < s->num_players; h++) {
         const auto *v = s->queues[h].confirmed(f);
-        if (v) row.insert(row.end(), v->begin(), v->end());
-        else row.insert(row.end(), (size_t)s->input_size, 0);
+        if (v) {
+          row.insert(row.end(), v->begin(), v->end());
+          stats.push_back((uint8_t)GGRS_INPUT_CONFIRMED);
+        } else {
+          /* stream the status the HOST's sim used: DISCONNECTED for a
+           * dead player's post-consensus frames, PREDICTED (default
+           * input) for pre-stream-base frames */
+          row.insert(row.end(), (size_t)s->input_size, 0);
+          auto it = s->remote_handle_addr.find(h);
+          bool disc = it != s->remote_handle_addr.end() &&
+                      s->endpoints[it->second]->disconnected;
+          stats.push_back((uint8_t)(disc ? GGRS_INPUT_DISCONNECTED
+                                         : GGRS_INPUT_PREDICTED));
+        }
       }
+      row.insert(row.end(), stats.begin(), stats.end());
       s->spectator_sent.emplace_back(f, std::move(row));
       s->next_spectator_frame = f + 1;
     }
@@ -1067,7 +1082,7 @@ GgrsSpectator *ggrs_spectator_create(int num_players, int input_size,
   auto ep = std::make_unique<Endpoint>();
   ep->addr = s->host;
   ep->sock = &s->sock;
-  ep->input_size = input_size * num_players; /* full-row stream */
+  ep->input_size = input_size * num_players + num_players; /* inputs + status bytes */
   ep->sync_nonce = s->rng();
   ep->disconnect_timeout_s = disconnect_timeout_s;
   ep->disconnect_notify_s = disconnect_notify_s;
@@ -1113,17 +1128,23 @@ int ggrs_spectator_advance(GgrsSpectator *s, int32_t *req_buf, int req_cap,
   int n = 1;
   if (ggrs_spectator_frames_behind(s) > 2) n += s->catchup_speed > 0 ? s->catchup_speed : 0;
   int rw = 0, ib = 0;
-  int row = s->num_players * s->input_size;
+  int row_inputs = s->num_players * s->input_size;
   for (int i = 0; i < n; i++) {
     auto it = s->inputs.find(s->current_frame);
     if (it == s->inputs.end()) break;
-    if (rw + 2 + s->num_players > req_cap || ib + row > input_cap)
+    if (rw + 2 + s->num_players > req_cap || ib + row_inputs > input_cap)
       return GGRS_ERR_BUFFER_TOO_SMALL;
     req_buf[rw++] = GGRS_REQ_ADVANCE;
     req_buf[rw++] = s->current_frame;
-    for (int h = 0; h < s->num_players; h++) req_buf[rw++] = GGRS_INPUT_CONFIRMED;
-    memcpy(input_buf + ib, it->second.data(), row);
-    ib += row;
+    for (int h = 0; h < s->num_players; h++) {
+      /* per-player status streamed by the host (row tail; the endpoint
+       * slicer only stores full input_size rows, so the tail is always
+       * present — a fallback here would silently re-mark dead players
+       * CONFIRMED, the exact bug the status stream closes) */
+      req_buf[rw++] = (int32_t)it->second[row_inputs + h];
+    }
+    memcpy(input_buf + ib, it->second.data(), row_inputs);
+    ib += row_inputs;
     s->inputs.erase(it);
     s->current_frame = s->current_frame + 1;
   }
