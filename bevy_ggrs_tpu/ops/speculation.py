@@ -206,6 +206,7 @@ class SpeculationCache:
             self._entry_bytes.pop(s, None)
 
     def clear(self) -> None:
+        """Drop every cached branch (and its byte accounting)."""
         self._cache.clear()
         self._entry_bytes.clear()
 

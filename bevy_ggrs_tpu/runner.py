@@ -146,6 +146,8 @@ class GgrsRunner:
 
     @world.setter
     def world(self, value) -> None:
+        """Replace the live world; externally-set states are never donated
+        (the caller may hold references to their buffers)."""
         self._world = value
         self._world_donatable = False
 

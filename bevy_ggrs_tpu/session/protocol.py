@@ -194,6 +194,8 @@ class PeerEndpoint:
     # -- receiving ----------------------------------------------------------
 
     def handle(self, data: bytes) -> None:
+        """Feed one raw datagram through the protocol state machine
+        (untrusted input: malformed packets are dropped)."""
         if self.disconnected:
             # once disconnected, always disconnected (ggrs semantics): a late
             # packet from a dropped peer must not mutate input queues — the

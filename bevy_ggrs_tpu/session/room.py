@@ -394,6 +394,7 @@ class RoomSocket:
             pass
 
     def close(self) -> None:
+        """LEAVE the room (so the roster updates promptly) and close."""
         self.leave()
         self._sock.close()
 

@@ -153,6 +153,8 @@ class LazySlice:
         self._i = i
 
     def materialize(self):
+        """Slice the frame out of the stacked buffer (ONE jitted dispatch);
+        the result no longer pins the parent buffer."""
         if isinstance(self._i, tuple):
             return tree_index2(self._stacked, *self._i)
         return tree_index(self._stacked, self._i)
