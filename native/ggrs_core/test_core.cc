@@ -135,7 +135,7 @@ static void test_packet_fuzz() {
     size_t len = rng() % sizeof buf;
     for (size_t j = 0; j < len; j++) buf[j] = (uint8_t)rng();
     if (rng() % 2) { buf[0] = 0xA7; buf[1] = 0x47; }  /* valid magic, evil body */
-    if (len > 2 && rng() % 4 == 0) buf[2] = (uint8_t)(1 + rng() % 8);
+    if (len > 2 && rng() % 4 == 0) buf[2] = (uint8_t)(1 + rng() % 9); /* incl. DISC_NOTICE */
     (void)sendto(fd, buf, len, 0, (sockaddr *)&dst, sizeof dst);
     if (i % 50 == 0) ggrs_p2p_poll(a);
   }

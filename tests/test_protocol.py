@@ -125,6 +125,13 @@ def test_malformed_packets_ignored():
     a.handle(HDR.pack(0x1234, 3) + b"junk")  # wrong magic
     a.handle(HDR.pack(MAGIC, 99))  # unknown type
     a.handle(HDR.pack(MAGIC, T_CHECKSUM) + b"\x01")  # truncated body
+    from bevy_ggrs_tpu.session.protocol import T_DISC_NOTICE
+
+    seen = []
+    a.on_disc_notice = lambda h, f: seen.append((h, f))
+    a.handle(HDR.pack(MAGIC, T_DISC_NOTICE) + b"\x01")  # truncated notice
+    a.handle(HDR.pack(MAGIC, T_DISC_NOTICE))  # empty notice body
+    assert seen == []  # truncated notices never reach the session
     a.handle(HDR.pack(MAGIC, T_KEEP_ALIVE))
     assert a.state == SessionState.SYNCHRONIZING  # unaffected
 
