@@ -41,10 +41,12 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import telemetry
 from .app import App
 from .ops.batch import make_batched_padded_fn, stack_worlds
 from .ops.resim import pad_repeat_last
 from .session.events import (
+    DesyncDetected,
     MismatchedChecksumError,
     NotSynchronizedError,
     PredictionThresholdError,
@@ -159,6 +161,9 @@ class BatchedRunner:
     def tick(self) -> None:
         """One server tick: poll + step every lobby, flush as waves."""
         self.ticks += 1
+        telemetry.count(
+            "server_ticks_total", help="batched-server ticks (all lobbies)"
+        )
         per_lobby_ops: List[List[_Op]] = []
         for b, s in enumerate(self.sessions):
             per_lobby_ops.append(self._collect_ops(b, s))
@@ -177,9 +182,28 @@ class BatchedRunner:
     def _collect_ops(self, b: int, s) -> List[_Op]:
         if hasattr(s, "poll_remote_clients"):
             s.poll_remote_clients()
-        if hasattr(s, "events") and self.on_event is not None:
+        if hasattr(s, "events") and (
+            self.on_event is not None or telemetry.enabled()
+        ):
             for ev in s.events():
-                self.on_event(b, ev)
+                if isinstance(ev, DesyncDetected):
+                    telemetry.record(
+                        "checksum_mismatch", source="p2p", lobby=b,
+                        frames=[ev.frame], local_checksum=ev.local_checksum,
+                        remote_checksum=ev.remote_checksum, addr=repr(ev.addr),
+                    )
+                    if telemetry.forensics_dir() is not None:
+                        # lobby_world is a device gather — only pay it when
+                        # a report will actually be written
+                        telemetry.write_desync_report(
+                            "p2p_desync", reg=self.app.reg,
+                            world=self.lobby_world(b), frames=[ev.frame],
+                            local_checksum=ev.local_checksum,
+                            remote_checksum=ev.remote_checksum, addr=ev.addr,
+                            lobby=b,
+                        )
+                if self.on_event is not None:
+                    self.on_event(b, ev)
         if isinstance(s, SyncTestSession):
             handles = list(range(s.num_players()))
         else:
@@ -192,12 +216,19 @@ class BatchedRunner:
             with span("SessionAdvanceFrame"):
                 requests = s.advance_frame()
         except MismatchedChecksumError as e:
+            self._report_mismatch(b, e)
             if self.on_mismatch is not None:
                 self.on_mismatch(b, e)
                 return []
             raise
         except PredictionThresholdError:
             self.stalled[b] += 1
+            telemetry.count(
+                "stalled_frames_total", help="ticks skipped on stall",
+                kind="p2p", lobby=b,
+            )
+            telemetry.record("stall", lobby=b, frame=self.frames[b],
+                             reason="prediction_threshold")
             return []
         except NotSynchronizedError:
             return []
@@ -214,6 +245,17 @@ class BatchedRunner:
         if not loads:
             return
         self.rollbacks += len(loads)
+        if telemetry.enabled():
+            for b, f in loads:
+                telemetry.count("rollbacks_total", help="LoadRequests executed",
+                                lobby=b)
+                telemetry.observe(
+                    "rollback_depth", self.frames[b] - f,
+                    "frames rolled back per LoadRequest", lobby=b,
+                )
+                telemetry.record("rollback", lobby=b, to_frame=f,
+                                 from_frame=self.frames[b],
+                                 depth=self.frames[b] - f)
         with span("LoadWorldBatched"):
             fused = self._try_fused_load(loads)
             if fused is not None:
@@ -294,6 +336,16 @@ class BatchedRunner:
                 status[b] = pad_repeat_last(st, self.k_max - len(a))
                 n_real[b] = len(a)
             self.device_dispatches += 1
+            telemetry.count("device_dispatches_total",
+                            help="fused resim dispatches")
+            telemetry.count(
+                "resim_frames_total", sum(max(k - 1, 0) for k in ks),
+                help="frames resimulated beyond the first of each dispatch",
+            )
+            telemetry.record(
+                "dispatch", batched=True, k_hot=k_hot,
+                active_lobbies=sum(1 for k in ks if k > 0),
+            )
             with span("AdvanceWorldBatched"):
                 finals, stacked, checks_flat = self.fn(
                     self.worlds, inputs, status, starts, n_real
@@ -334,6 +386,20 @@ class BatchedRunner:
 
     # -- observability ------------------------------------------------------
 
+    def _report_mismatch(self, b: int, e: MismatchedChecksumError) -> None:
+        """Lobby SyncTest mismatch: timeline event + forensics report."""
+        telemetry.record(
+            "checksum_mismatch", source="synctest", lobby=b,
+            frames=list(e.mismatched_frames), current_frame=e.current_frame,
+        )
+        if telemetry.forensics_dir() is not None:
+            # lobby_world is a device gather — only pay it when a report
+            # will actually be written
+            telemetry.write_desync_report(
+                "synctest_mismatch", reg=self.app.reg,
+                world=self.lobby_world(b), frames=e.mismatched_frames, lobby=b,
+            )
+
     def stats(self) -> dict:
         return {
             "lobbies": len(self.sessions),
@@ -363,6 +429,7 @@ class BatchedRunner:
                 try:
                     s.check_now()
                 except MismatchedChecksumError as e:
+                    self._report_mismatch(b, e)
                     if self.on_mismatch is not None:
                         self.on_mismatch(b, e)
                     else:

@@ -18,9 +18,12 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from . import telemetry
 from .app import App
 from .session.events import (
+    DesyncDetected,
     InputStatus,
+    InvalidRequestError,
     MismatchedChecksumError,
     NotSynchronizedError,
     PredictionThresholdError,
@@ -251,6 +254,7 @@ class GgrsRunner:
             s.check_now()
         except MismatchedChecksumError as e:
             trace_log("SyncTest mismatch (flush): %s", e)
+            self._report_mismatch(e)
             if self.on_mismatch is not None:
                 self.on_mismatch(e)
             else:
@@ -275,6 +279,8 @@ class GgrsRunner:
             with span("PollRemoteClients"):
                 self.session.poll_remote_clients()
             self._drain_events()
+            if telemetry.enabled():
+                self._record_network_stats()
         pending: List[GgrsRequest] = []
         pending_ticks = 0
         while self.accumulator >= fps_delta:
@@ -359,6 +365,7 @@ class GgrsRunner:
         caller — possibly coalesced with other ticks'), or None if the tick
         produced nothing (stall, handshake, mismatch)."""
         self.ticks += 1
+        telemetry.count("ticks_total", help="session ticks stepped")
         s = self.session
         if isinstance(s, SyncTestSession):
             return self._step_synctest()
@@ -376,6 +383,7 @@ class GgrsRunner:
                 return s.advance_frame()
         except MismatchedChecksumError as e:
             trace_log("SyncTest mismatch: %s", e)
+            self._report_mismatch(e)
             if self.on_mismatch is not None:
                 self.on_mismatch(e)
             return None
@@ -392,6 +400,8 @@ class GgrsRunner:
         except PredictionThresholdError:
             trace_log("frame %d skipped: prediction threshold", self.frame)
             self.stalled_frames += 1
+            telemetry.count("stalled_frames_total", help="ticks skipped on stall", kind="p2p")
+            telemetry.record("stall", frame=self.frame, reason="prediction_threshold")
             return None
         except NotSynchronizedError:
             return None  # still in the sync handshake; sim time does not advance
@@ -408,6 +418,10 @@ class GgrsRunner:
         except PredictionThresholdError:
             trace_log("spectator frame skipped: waiting for host input")
             self.stalled_frames += 1
+            telemetry.count(
+                "stalled_frames_total", help="ticks skipped on stall", kind="spectator"
+            )
+            telemetry.record("stall", frame=self.frame, reason="waiting_for_host")
             return None
         except NotSynchronizedError:
             return None
@@ -417,8 +431,79 @@ class GgrsRunner:
         if hasattr(s, "events"):
             for ev in s.events():
                 self.events.append(ev)
+                if isinstance(ev, DesyncDetected):
+                    self._report_desync(ev)
                 if self.on_event is not None:
                     self.on_event(ev)
+
+    def _record_network_stats(self) -> None:
+        """Mirror per-peer NetworkStats into telemetry gauges plus one
+        timeline event per peer (called once per host tick while enabled)."""
+        s = self.session
+        handles = getattr(s, "remote_handle_addr", None)
+        if handles is None:
+            if getattr(s, "is_spectator", False):
+                behind = s.frames_behind_host()
+                telemetry.gauge_set(
+                    "spectator_frames_behind", behind, "spectator catchup lag"
+                )
+                telemetry.record("network_stats", peer="host", frames_behind=behind)
+            return
+        for h in sorted(handles):
+            try:
+                st = s.network_stats(h)
+            except InvalidRequestError:
+                continue  # endpoint gone (disconnect)
+            telemetry.gauge_set("ping_ms", st.ping_ms, "round-trip ping", peer=h)
+            telemetry.gauge_set(
+                "send_queue_len", st.send_queue_len, "pending outbound inputs",
+                peer=h,
+            )
+            telemetry.gauge_set("kbps_sent", st.kbps_sent, "outbound bandwidth", peer=h)
+            telemetry.gauge_set(
+                "local_frames_behind", st.local_frames_behind,
+                "our frame lag vs this peer", peer=h,
+            )
+            telemetry.gauge_set(
+                "remote_frames_behind", st.remote_frames_behind,
+                "peer's frame lag vs us", peer=h,
+            )
+            telemetry.record(
+                "network_stats", peer=h, ping_ms=st.ping_ms,
+                send_queue_len=st.send_queue_len, kbps_sent=st.kbps_sent,
+                local_frames_behind=st.local_frames_behind,
+                remote_frames_behind=st.remote_frames_behind,
+            )
+        if hasattr(s, "frames_ahead"):
+            telemetry.observe(
+                "input_latency_frames", max(s.frames_ahead(), 0),
+                "frames the session runs ahead of confirmed remote input",
+            )
+
+    def _report_mismatch(self, e: MismatchedChecksumError) -> None:
+        """SyncTest mismatch: timeline event + forensics report (the report
+        is written only when a forensics directory is configured)."""
+        telemetry.record(
+            "checksum_mismatch", source="synctest",
+            frames=list(e.mismatched_frames), current_frame=e.current_frame,
+        )
+        telemetry.write_desync_report(
+            "synctest_mismatch", reg=self.app.reg, world=self.world,
+            frames=e.mismatched_frames,
+        )
+
+    def _report_desync(self, ev: DesyncDetected) -> None:
+        """P2P DesyncDetected: timeline event + forensics report."""
+        telemetry.record(
+            "checksum_mismatch", source="p2p", frames=[ev.frame],
+            local_checksum=ev.local_checksum,
+            remote_checksum=ev.remote_checksum, addr=repr(ev.addr),
+        )
+        telemetry.write_desync_report(
+            "p2p_desync", reg=self.app.reg, world=self.world,
+            frames=[ev.frame], local_checksum=ev.local_checksum,
+            remote_checksum=ev.remote_checksum, addr=ev.addr,
+        )
 
     # -- request dispatch (the TPU-offload seam, SURVEY §3.6) ---------------
 
@@ -461,6 +546,13 @@ class GgrsRunner:
         """LoadGameState: restore the ring snapshot for ``frame``
         (schedule_systems.rs:238-249)."""
         self.rollbacks += 1
+        telemetry.count("rollbacks_total", help="LoadRequests executed")
+        telemetry.observe(
+            "rollback_depth", self.frame - frame,
+            "frames rolled back per LoadRequest",
+        )
+        telemetry.record("rollback", to_frame=frame, from_frame=self.frame,
+                         depth=self.frame - frame)
         with span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
             was_lazy = isinstance(stored, LazySlice)
@@ -504,6 +596,11 @@ class GgrsRunner:
         if self.spec_cache is not None and k > 0:
             got = self.spec_cache.lookup_seq(
                 self.frame, np.stack([a.inputs for a in adv])
+            )
+            telemetry.count(
+                "speculation_hits_total" if got is not None
+                else "speculation_misses_total",
+                help="speculative branch-cache lookups",
             )
             if got is not None:
                 skip, cache_states, cache_checks = got
@@ -557,6 +654,15 @@ class GgrsRunner:
         if k - skip > 0:
             self.device_dispatches += 1
             self.rollback_frames += max(k - skip - 1, 0)
+            telemetry.count("device_dispatches_total", help="fused resim dispatches")
+            telemetry.count(
+                "resim_frames_total", max(k - skip - 1, 0),
+                help="frames resimulated beyond the first of each dispatch",
+            )
+            if donate:
+                telemetry.count(
+                    "donated_dispatches_total", help="dispatches donating the input world"
+                )
             with span("AdvanceWorld"):
                 inputs = np.stack([a.inputs for a in adv[skip:]])
                 status = np.stack([a.status for a in adv[skip:]])
@@ -588,8 +694,15 @@ class GgrsRunner:
         if stacked is not None:
             from .utils.mem import tree_device_bytes
 
-            materialize_saves = (
-                tree_device_bytes(stacked) > self.ring_materialize_bytes
+            stacked_bytes = tree_device_bytes(stacked)
+            materialize_saves = stacked_bytes > self.ring_materialize_bytes
+            telemetry.gauge_set(
+                "save_bytes", stacked_bytes,
+                "device bytes of the last dispatch's stacked save buffer",
+            )
+            telemetry.record(
+                "dispatch", frame=self.frame, advances=k - skip, skipped=skip,
+                donated=donate, save_bytes=stacked_bytes,
             )
         pushed_pre_world = False
         with span("SaveWorld"):

@@ -8,7 +8,7 @@ real sockets."""
 from __future__ import annotations
 
 import random
-from typing import Any, Deque, Dict, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 
 class ChannelNetwork:

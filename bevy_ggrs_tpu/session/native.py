@@ -17,6 +17,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import telemetry
 from .events import (
     DesyncDetected,
     DesyncDetection,
@@ -349,6 +350,10 @@ class NativeP2PSession:
             elif k == _EV_RES:
                 self.events_buf.append(NetworkResumed(s))
             elif k == _EV_DESYNC:
+                telemetry.count(
+                    "checksum_mismatch_total",
+                    help="frames whose checksums disagreed", kind="p2p",
+                )
                 self.events_buf.append(
                     DesyncDetected(
                         frame=a.value, local_checksum=int(b2.value),

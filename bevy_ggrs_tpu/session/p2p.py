@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..utils.frames import (
     NULL_FRAME,
     frame_add,
@@ -583,6 +584,10 @@ class P2PSession:
             for (addr, f), remote in list(self._remote_checksums.items()):
                 if f == frame:
                     if remote != entry:
+                        telemetry.count(
+                            "checksum_mismatch_total",
+                            help="frames whose checksums disagreed", kind="p2p",
+                        )
                         self.events_buf.append(
                             DesyncDetected(
                                 frame=f,

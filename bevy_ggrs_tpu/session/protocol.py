@@ -10,8 +10,11 @@ The byte format is little-endian and fixed (shared with the native C++ core
 in native/ggrs_core — keep in sync with message.h):
 
     header:  magic:u16  type:u8
-    SYNC_REQ   nonce:u32
-    SYNC_REP   nonce:u32
+    SYNC_REQ   nonce:u32 version:u8
+    SYNC_REP   nonce:u32 version:u8
+               (version gates the handshake: mismatched or missing version
+               gets no reply, so mixed-version pairs stall in SYNCHRONIZING
+               instead of mis-parsing each other's streams)
     INPUT      start_frame:i32 count:u16 ack_frame:i32 advantage:i8
                stream_base:i32 payload: count * input_size bytes
                (stream_base = sender's first-ever input frame: lets a
@@ -36,6 +39,7 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from ..utils.frames import NULL_FRAME, frame_gt
+from ..utils.tracing import trace_log
 from .events import (
     Disconnected,
     NetworkInterrupted,
@@ -65,8 +69,17 @@ T_CHECKSUM = 8
 # dying peer's stream diverge permanently)
 T_DISC_NOTICE = 9
 
-S_SYNC_REQ = struct.Struct("<I")
-S_SYNC_REP = struct.Struct("<I")
+# Wire protocol version, carried in the sync handshake (REQ and REP both
+# append version:u8 after the nonce).  A peer speaking a different version —
+# or a pre-versioning build whose sync messages are 4 bytes — never gets a
+# valid reply, so the pair stalls in SYNCHRONIZING instead of mis-parsing
+# each other's input rows mid-game.  Bump on ANY wire-format change (shared
+# with native/ggrs_core/ggrs_core.cc — keep in sync).
+PROTOCOL_VERSION = 1
+
+S_SYNC_REQ = struct.Struct("<IB")
+S_SYNC_REP = struct.Struct("<IB")
+_S_SYNC_NONCE = struct.Struct("<I")  # the pre-version prefix
 S_INPUT = struct.Struct("<iHibi")
 S_INPUT_ACK = struct.Struct("<i")
 S_QUAL_REQ = struct.Struct("<Qb")
@@ -193,6 +206,31 @@ class PeerEndpoint:
 
     # -- receiving ----------------------------------------------------------
 
+    def _sync_version_ok(self, body: bytes) -> bool:
+        """Validate the version byte of a sync message body.
+
+        Missing (pre-versioning 4-byte message) or mismatched versions fail;
+        the caller drops the packet without replying, stalling the
+        handshake."""
+        if len(body) < S_SYNC_REQ.size:
+            ver = None  # pre-versioning peer
+        else:
+            ver = body[_S_SYNC_NONCE.size]
+        if ver == PROTOCOL_VERSION:
+            return True
+        from .. import telemetry
+
+        telemetry.count(
+            "handshake_version_mismatch_total",
+            help="sync messages dropped for a wrong/missing protocol version",
+            remote_version=("none" if ver is None else ver),
+        )
+        trace_log(
+            "dropping sync message from %s: protocol version %s != %d",
+            self.addr, ver, PROTOCOL_VERSION,
+        )
+        return False
+
     def handle(self, data: bytes) -> None:
         """Feed one raw datagram through the protocol state machine
         (untrusted input: malformed packets are dropped)."""
@@ -222,10 +260,14 @@ class PeerEndpoint:
             self.interrupted = False
             self.events.append(NetworkResumed(self.addr))
         if t == T_SYNC_REQ:
-            (nonce,) = S_SYNC_REQ.unpack_from(body)
-            self._send(T_SYNC_REP, S_SYNC_REP.pack(nonce))
+            if not self._sync_version_ok(body):
+                return  # no reply: a mixed-version pair must stall, not run
+            (nonce, _ver) = S_SYNC_REQ.unpack_from(body)
+            self._send(T_SYNC_REP, S_SYNC_REP.pack(nonce, PROTOCOL_VERSION))
         elif t == T_SYNC_REP:
-            (nonce,) = S_SYNC_REP.unpack_from(body)
+            if not self._sync_version_ok(body):
+                return
+            (nonce, _ver) = S_SYNC_REP.unpack_from(body)
             if self.state == SessionState.SYNCHRONIZING and nonce == self._sync_nonce:
                 self._sync_remaining -= 1
                 self._sync_nonce = (self._sync_nonce * 6364136223846793005 + 1) & 0xFFFFFFFF
@@ -243,7 +285,10 @@ class PeerEndpoint:
                     # continue the handshake immediately (RTT-bound, not
                     # retry-timer-bound); the timer only covers loss
                     self._last_sync_sent = now_s()
-                    self._send(T_SYNC_REQ, S_SYNC_REQ.pack(self._sync_nonce))
+                    self._send(
+                        T_SYNC_REQ,
+                        S_SYNC_REQ.pack(self._sync_nonce, PROTOCOL_VERSION),
+                    )
         elif t == T_INPUT:
             start, count, ack, adv, base = S_INPUT.unpack_from(body)
             self._note_ack(ack)
@@ -326,7 +371,10 @@ class PeerEndpoint:
         if self.state == SessionState.SYNCHRONIZING:
             if t - self._last_sync_sent >= SYNC_RETRY_S:
                 self._last_sync_sent = t
-                self._send(T_SYNC_REQ, S_SYNC_REQ.pack(self._sync_nonce))
+                self._send(
+                    T_SYNC_REQ,
+                    S_SYNC_REQ.pack(self._sync_nonce, PROTOCOL_VERSION),
+                )
             return
         if t - self._last_quality_sent >= QUALITY_INTERVAL_S:
             self._last_quality_sent = t

@@ -150,13 +150,24 @@ class RoomServer:
             # old membership intact (dropping it before the check would
             # deregister the socket entirely on a full destination)
             members = self.rooms.setdefault(room, {})
-            if peer not in members and len(members) >= MAX_ROOM_MEMBERS:
+            prev = self._addr_index.get(addr)
+            occupied = len(members)
+            if (
+                prev is not None
+                and prev[0] == room
+                and prev[1] in members
+                and members[prev[1]][0] == addr
+            ):
+                # the joining socket already holds a slot HERE — a rejoin
+                # under a new peer id frees it, so it must not count against
+                # capacity (a full room would otherwise reject its own member)
+                occupied -= 1
+            if peer not in members and occupied >= MAX_ROOM_MEMBERS:
                 return  # room full: drop the join (bounds the roster byte)
             # one socket = one membership: a JOIN from an addr already
             # registered elsewhere moves it (otherwise _prune on the stale
             # membership would pop the LIVE _addr_index entry and the
             # member's pings/relays would be silently ignored)
-            prev = self._addr_index.get(addr)
             if prev is not None and prev != (room, peer):
                 self._drop_member(*prev, broadcast=True)
                 members = self.rooms.setdefault(room, {})

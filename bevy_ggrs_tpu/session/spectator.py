@@ -13,6 +13,7 @@ from typing import Any, Dict, List
 
 import numpy as np
 
+from .. import telemetry
 from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import (
     NetworkStats,
@@ -130,6 +131,14 @@ class SpectatorSession:
         n = 1
         if self.frames_behind_host() > 2:
             n += max(self.catchup_speed, 0)
+            telemetry.count(
+                "spectator_catchup_ticks_total",
+                help="spectator ticks that replayed extra frames to catch up",
+            )
+            telemetry.record(
+                "spectator_catchup", frame=self.current_frame,
+                behind=self.frames_behind_host(), replaying=n,
+            )
         requests: List = []
         for _ in range(n):
             if self.current_frame not in self._inputs:

@@ -18,6 +18,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from .. import telemetry
 from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import InputStatus, InvalidRequestError, MismatchedChecksumError
 from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
@@ -214,6 +215,10 @@ class SyncTestSession:
                 mismatched.append(frame)
         if mismatched:
             frames = sorted(mismatched)
+            telemetry.count(
+                "checksum_mismatch_total", len(frames),
+                help="frames whose checksums disagreed", kind="synctest",
+            )
             for fr in frames:
                 del self._cells[fr]
                 self._compared_len.pop(fr, None)

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import socket
 from collections import deque
-from typing import Any, List, Optional, Protocol, Tuple
+from typing import Any, List, Protocol, Tuple
 
 
 class NonBlockingSocket(Protocol):

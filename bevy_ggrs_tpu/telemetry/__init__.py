@@ -1,0 +1,141 @@
+"""Unified telemetry subsystem: metrics registry, per-frame rollback
+timeline, and desync forensics export.
+
+The reference plugin leans on Bevy's tracing backend for observability; our
+seed had a span ring plus ad-hoc counters scattered across three layers.
+This package is the single replacement surface:
+
+- :mod:`.metrics` — process-local registry of counters / gauges / labeled
+  histograms (``rollback_depth``, ``resim_frames_total``,
+  ``speculation_hit_ratio``, ``checksum_mismatch_total``, ...).
+- :mod:`.timeline` — one ordered event stream per process merging the span
+  ring, per-peer network stats and driver decisions; JSONL export.
+- :mod:`.forensics` — per-component checksum reports on desync.
+- :mod:`.prometheus` — HTTP ``/metrics`` exporter (room server).
+
+Everything is DISABLED by default and near-free while disabled; flip it on
+with :func:`enable` (or ``BGT_TELEMETRY=1`` in the environment).  Metric
+catalog and usage live in ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .forensics import (  # noqa: F401 (public re-exports)
+    component_checksums,
+    configure as configure_forensics,
+    forensics_dir,
+    write_desync_report,
+)
+from .metrics import (  # noqa: F401
+    FRAME_BUCKETS,
+    MS_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from .prometheus import MetricsExporter, start_http_exporter  # noqa: F401
+from .timeline import (  # noqa: F401
+    Timeline,
+    export_jsonl,
+    record,
+    span_sink,
+    timeline,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsExporter",
+    "Timeline", "FRAME_BUCKETS", "MS_BUCKETS",
+    "enable", "disable", "enabled", "reset", "summary",
+    "registry", "timeline", "record", "export_jsonl", "span_sink",
+    "count", "observe", "gauge_set",
+    "component_checksums", "configure_forensics", "forensics_dir",
+    "write_desync_report", "start_http_exporter",
+]
+
+
+def enabled() -> bool:
+    """True when telemetry recording is on."""
+    return registry().enabled
+
+
+def enable() -> None:
+    """Turn on metrics + timeline recording and hook the span ring in."""
+    registry().set_enabled(True)
+    from ..utils import tracing
+
+    tracing.set_span_sink(span_sink())
+
+
+def disable() -> None:
+    """Turn recording back off (recorded data stays until :func:`reset`)."""
+    registry().set_enabled(False)
+    from ..utils import tracing
+
+    tracing.set_span_sink(None)
+
+
+def reset() -> None:
+    """Drop all recorded metrics and timeline events (test isolation)."""
+    registry().reset()
+    timeline().clear()
+
+
+def count(name: str, n: float = 1, help: str = "", **labels) -> None:
+    """Increment counter ``name`` on the default registry (shorthand)."""
+    reg = registry()
+    if reg.enabled:
+        reg.counter(name, help).inc(n, **labels)
+
+
+def observe(name: str, v: float, help: str = "", buckets=FRAME_BUCKETS, **labels) -> None:
+    """Observe ``v`` on histogram ``name`` on the default registry."""
+    reg = registry()
+    if reg.enabled:
+        reg.histogram(name, help, buckets=buckets).observe(v, **labels)
+
+
+def gauge_set(name: str, v: float, help: str = "", **labels) -> None:
+    """Set gauge ``name`` on the default registry."""
+    reg = registry()
+    if reg.enabled:
+        reg.gauge(name, help).set(v, **labels)
+
+
+def summary() -> dict:
+    """One merged dict of everything: the ``bench.py`` BENCH payload.
+
+    Includes derived ratios (``speculation_hit_ratio``) computed from the
+    raw counters so consumers need no metric arithmetic."""
+    reg = registry()
+    snap = reg.snapshot()
+
+    def _total(name: str) -> float:
+        fam = snap.get(name)
+        if not fam:
+            return 0.0
+        return float(sum(v if not isinstance(v, dict) else v.get("count", 0)
+                         for v in fam["series"].values()))
+
+    hits = _total("speculation_hits_total")
+    misses = _total("speculation_misses_total")
+    return {
+        "enabled": reg.enabled,
+        "metrics": snap,
+        "derived": {
+            "speculation_hit_ratio": (
+                round(hits / (hits + misses), 4) if hits + misses else None
+            ),
+            "rollbacks_total": _total("rollbacks_total"),
+            "resim_frames_total": _total("resim_frames_total"),
+            "checksum_mismatch_total": _total("checksum_mismatch_total"),
+        },
+        "timeline_events": len(timeline()),
+    }
+
+
+if os.environ.get("BGT_TELEMETRY", "").strip() in ("1", "true", "on", "yes"):
+    enable()

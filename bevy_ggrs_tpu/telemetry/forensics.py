@@ -1,0 +1,129 @@
+"""Desync forensics — per-component checksum dumps on checksum mismatch.
+
+A 64-bit world checksum says two peers diverged; it cannot say WHERE.  This
+module decomposes the divergence: on a SyncTest mismatch or a P2P
+``DesyncDetected`` event the driver calls :func:`write_desync_report`, which
+hashes every registered component/resource SEPARATELY (the same per-type
+parts ``snapshot/checksum.py`` XORs into the world checksum), attaches the
+last N timeline events plus the full metrics snapshot, and writes one JSON
+report file.  Diffing two peers' reports names the diverged component
+directly — the workflow is documented in ``docs/debugging-desyncs.md`` §6.
+
+Reports are written only when a directory is configured
+(:func:`configure` or ``BGT_FORENSICS_DIR``); the hooks are otherwise free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from . import metrics as _metrics
+from . import timeline as _timeline
+
+_STATE = {
+    "dir": os.environ.get("BGT_FORENSICS_DIR") or None,
+    "timeline_tail": 200,
+}
+
+
+def configure(dir: Optional[str] = None, timeline_tail: Optional[int] = None) -> None:
+    """Set the report directory (None disables) and timeline excerpt length."""
+    _STATE["dir"] = dir
+    if timeline_tail is not None:
+        _STATE["timeline_tail"] = int(timeline_tail)
+
+
+def forensics_dir() -> Optional[str]:
+    """The configured report directory, or None when reporting is off."""
+    return _STATE["dir"]
+
+
+def component_checksums(reg, world) -> dict:
+    """Per-part 64-bit checksums of ``world``: one per checksummed component
+    and resource, plus the entity part — all pulled in ONE device transfer.
+
+    Keys are component names, ``res:<name>`` for resources and
+    ``__entities__``; values are ints comparable across peers exactly like
+    the combined world checksum (uint32 math — see snapshot/checksum.py)."""
+    import jax
+
+    from ..snapshot.checksum import (
+        _SEED_HI,
+        _SEED_LO,
+        component_part,
+        entity_part,
+        resource_part,
+    )
+
+    parts = {}
+    for name, spec in reg.components.items():
+        if spec.checksum:
+            parts[name] = [
+                component_part(reg, world, name, _SEED_HI),
+                component_part(reg, world, name, _SEED_LO),
+            ]
+    for name, spec in reg.resources.items():
+        if spec.checksum:
+            parts["res:" + name] = [
+                resource_part(reg, world, name, _SEED_HI),
+                resource_part(reg, world, name, _SEED_LO),
+            ]
+    parts["__entities__"] = [entity_part(world, _SEED_HI), entity_part(world, _SEED_LO)]
+    host = jax.device_get(parts)
+    return {
+        name: (int(hi) << 32) | int(lo) for name, (hi, lo) in host.items()
+    }
+
+
+def write_desync_report(
+    kind: str,
+    reg=None,
+    world=None,
+    frames=None,
+    local_checksum: Optional[int] = None,
+    remote_checksum: Optional[int] = None,
+    addr=None,
+    lobby: Optional[int] = None,
+    path: Optional[str] = None,
+) -> Optional[str]:
+    """Dump a desync forensics report; returns the file path (or None when
+    no directory is configured and no explicit ``path`` given).
+
+    ``kind`` is ``"synctest_mismatch"`` or ``"p2p_desync"``; ``reg``/``world``
+    (when available) produce the per-component checksum section."""
+    if path is None:
+        d = _STATE["dir"]
+        if d is None:
+            return None
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"desync_{kind}_{int(time.time() * 1e3)}_{os.getpid()}.json"
+        )
+    report = {
+        "kind": kind,
+        "ts": time.time(),
+        "frames": list(frames) if frames is not None else None,
+        "local_checksum": local_checksum,
+        "remote_checksum": remote_checksum,
+        "addr": repr(addr) if addr is not None else None,
+        "lobby": lobby,
+        "component_checksums": (
+            component_checksums(reg, world)
+            if reg is not None and world is not None
+            else None
+        ),
+        "timeline_tail": _timeline.timeline().tail(_STATE["timeline_tail"]),
+        "metrics": _metrics.registry().snapshot(),
+    }
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, default=repr)
+    reg_ = _metrics.registry()
+    if reg_.enabled:
+        reg_.counter(
+            "desync_reports_total", "forensics reports written"
+        ).inc(kind=kind)
+    _timeline.record("desync_report", report_kind=kind, path=path)
+    return path

@@ -1,0 +1,254 @@
+"""Process-local metrics registry — counters, gauges, histograms with labels.
+
+The unified stat mechanism replacing the ad-hoc integer attributes scattered
+across ``runner.py`` / ``batch_runner.py`` / ``session/p2p.py``: every driver
+and session counter routes through one :class:`MetricsRegistry` so a single
+``snapshot()`` (or Prometheus scrape — see :mod:`.prometheus`) answers "why
+did this lobby stall / desync / roll back 7 frames".
+
+Cost model: the registry is DISABLED by default.  Every mutating call
+(``inc``/``set``/``observe``) returns after one attribute check when
+disabled, so instrumented hot paths (the per-tick driver loop) pay a few ns
+per site — the <2% bench budget in ISSUE.md.  Enable with
+:func:`bevy_ggrs_tpu.telemetry.enable` (or ``BGT_TELEMETRY=1``).
+
+Label semantics follow Prometheus: a metric name owns a family of time
+series keyed by sorted ``(label, value)`` pairs.  Histograms use fixed
+upper-bound buckets (cumulative on export, like Prometheus ``le``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# default histogram buckets, tuned for the two native unit families:
+# frames (rollback depth, input latency — small ints) and milliseconds
+FRAME_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
+MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    """Common base: name, help text, per-label-set series storage."""
+
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str = ""):
+        self._reg = registry
+        self.name = name
+        self.help = help
+        self._series: Dict[LabelKey, object] = {}
+
+    def series(self) -> Dict[LabelKey, object]:
+        """Raw per-label-set values (shallow copy, lock-protected)."""
+        with self._reg._lock:
+            return dict(self._series)
+
+
+class Counter(_Metric):
+    """Monotonically increasing value (e.g. ``rollbacks_total``)."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        """Add ``n`` (default 1) to the series selected by ``labels``."""
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        with self._reg._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        """Current value of one series (0 if never incremented)."""
+        return self._series.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Point-in-time value that can go up or down (e.g. ``ping_ms``)."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        """Set the series selected by ``labels`` to ``v``."""
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            self._series[_label_key(labels)] = v
+
+    def inc(self, n: float = 1, **labels) -> None:
+        """Add ``n`` to the gauge (down with negative ``n``)."""
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        with self._reg._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        """Current value of one series (0 if never set)."""
+        return self._series.get(_label_key(labels), 0)
+
+
+class Histogram(_Metric):
+    """Fixed-bucket distribution (e.g. ``rollback_depth`` in frames).
+
+    Each series stores per-bucket counts plus ``sum``/``count``; export
+    renders cumulative Prometheus ``le`` buckets."""
+
+    kind = "histogram"
+
+    def __init__(self, registry, name, help="", buckets: Sequence[float] = FRAME_BUCKETS):
+        super().__init__(registry, name, help)
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets))
+
+    def observe(self, v: float, **labels) -> None:
+        """Record one observation of ``v``."""
+        if not self._reg.enabled:
+            return
+        key = _label_key(labels)
+        with self._reg._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = {"buckets": [0] * len(self.buckets), "sum": 0.0, "count": 0}
+                self._series[key] = s
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    s["buckets"][i] += 1
+                    break
+            s["sum"] += v
+            s["count"] += 1
+
+    def snapshot(self, **labels) -> Optional[dict]:
+        """One series as ``{"buckets", "sum", "count"}`` (or None)."""
+        s = self._series.get(_label_key(labels))
+        if s is None:
+            return None
+        return {"buckets": list(s["buckets"]), "sum": s["sum"], "count": s["count"]}
+
+
+class MetricsRegistry:
+    """Get-or-create metric families; snapshot/export the lot.
+
+    One instance per process is the intended shape (:func:`registry`); tests
+    may build private registries.  ``enabled`` gates every mutation — flip it
+    with :meth:`set_enabled` (the package-level ``enable()``/``disable()``
+    forward here)."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Enable/disable all mutation on this registry's metrics."""
+        self.enabled = bool(enabled)
+
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(self, name, help, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        """Get or create a :class:`Counter` named ``name``."""
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        """Get or create a :class:`Gauge` named ``name``."""
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets: Sequence[float] = FRAME_BUCKETS
+    ) -> Histogram:
+        """Get or create a :class:`Histogram` named ``name``."""
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> List[_Metric]:
+        """All registered metric families, name-sorted."""
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self) -> dict:
+        """Plain-dict dump: ``{name: {"kind", "help", "series": {...}}}``.
+
+        Series keys are rendered as ``label=value,label=value`` strings
+        ("" for the unlabeled series) so the result is JSON-serializable —
+        this is the dict ``bench.py`` merges into BENCH output."""
+        out = {}
+        for m in self.metrics():
+            series = {}
+            for key, val in m.series().items():
+                skey = ",".join(f"{k}={v}" for k, v in key)
+                if isinstance(val, dict):  # histogram series
+                    series[skey] = {
+                        "sum": val["sum"],
+                        "count": val["count"],
+                        "buckets": dict(
+                            zip([str(b) for b in m.buckets], val["buckets"])
+                        ),
+                    }
+                else:
+                    series[skey] = val
+            out[m.name] = {"kind": m.kind, "help": m.help, "series": series}
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric family (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4) of everything."""
+        lines: List[str] = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, val in sorted(m.series().items()):
+                if isinstance(val, dict):  # histogram
+                    cum = 0
+                    for ub, n in zip(m.buckets, val["buckets"]):
+                        cum += n
+                        lines.append(
+                            f"{m.name}_bucket{_fmt_labels(key, le=_fmt_float(ub))} {cum}"
+                        )
+                    lines.append(
+                        f'{m.name}_bucket{_fmt_labels(key, le="+Inf")} {val["count"]}'
+                    )
+                    lines.append(f"{m.name}_sum{_fmt_labels(key)} {_fmt_float(val['sum'])}")
+                    lines.append(f"{m.name}_count{_fmt_labels(key)} {val['count']}")
+                else:
+                    lines.append(f"{m.name}{_fmt_labels(key)} {_fmt_float(val)}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_float(v) -> str:
+    """Render a number the way Prometheus text format expects."""
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return str(v)
+
+
+def _fmt_labels(key: LabelKey, **extra) -> str:
+    parts = [f'{k}="{v}"' for k, v in key] + [f'{k}="{v}"' for k, v in extra.items()]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default registry."""
+    return _REGISTRY

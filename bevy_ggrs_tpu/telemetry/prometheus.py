@@ -1,0 +1,73 @@
+"""Prometheus HTTP exporter — serve the registry on ``GET /metrics``.
+
+A tiny stdlib ``ThreadingHTTPServer`` wrapper so any long-lived process
+(``scripts/room_server.py`` is the shipped consumer) can expose the metrics
+registry to a Prometheus scraper with one call:
+
+    from bevy_ggrs_tpu.telemetry import start_http_exporter
+    exporter = start_http_exporter(port=9464)
+    ...
+    exporter.close()
+
+The handler renders :meth:`MetricsRegistry.render_prometheus` per scrape —
+no caching, no extra thread work between scrapes."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import MetricsRegistry, registry as _default_registry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Background HTTP server exposing one registry (see module docstring)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else _default_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            """Per-scrape request handler (``/metrics`` + ``/`` index)."""
+
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                """Serve the current exposition text."""
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = reg.render_prometheus().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                """Silence per-request stderr logging."""
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="ggrs-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        """The bound port (useful with ``port=0``)."""
+        return self._server.server_address[1]
+
+    def close(self) -> None:
+        """Stop serving and release the socket."""
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
+                        registry: Optional[MetricsRegistry] = None) -> MetricsExporter:
+    """Start a :class:`MetricsExporter`; returns it (``.port``, ``.close()``)."""
+    return MetricsExporter(port=port, host=host, registry=registry)

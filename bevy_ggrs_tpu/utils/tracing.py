@@ -14,18 +14,29 @@ import contextlib
 import logging
 import time
 from collections import deque
-from typing import Deque, Tuple
+from typing import Callable, Deque, Optional, Tuple
 
 logger = logging.getLogger("bevy_ggrs_tpu")
 
 _EVENTS: Deque[Tuple[str, float, float]] = deque(maxlen=4096)
 _ENABLED = True
+_SPAN_SINK: Optional[Callable[[str, float, float], None]] = None
 
 
 def set_tracing(enabled: bool) -> None:
     """Globally enable/disable span recording."""
     global _ENABLED
     _ENABLED = enabled
+
+
+def set_span_sink(sink: Optional[Callable[[str, float, float], None]]) -> None:
+    """Install a callback fed every completed span as ``(name, t0, t1)``.
+
+    The telemetry timeline (``telemetry.enable()``) installs its sink here;
+    None uninstalls.  The sink runs inside the span's ``finally`` — keep it
+    cheap and non-raising."""
+    global _SPAN_SINK
+    _SPAN_SINK = sink
 
 
 @contextlib.contextmanager
@@ -40,6 +51,8 @@ def span(name: str):
     finally:
         t1 = time.perf_counter()
         _EVENTS.append((name, t0, t1))
+        if _SPAN_SINK is not None:
+            _SPAN_SINK(name, t0, t1)
         logger.debug("span %s: %.3f ms", name, (t1 - t0) * 1e3)
 
 

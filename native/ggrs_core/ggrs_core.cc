@@ -43,6 +43,11 @@ constexpr uint16_t MAGIC = 0x47A7;
 constexpr uint8_t T_SYNC_REQ = 1, T_SYNC_REP = 2, T_INPUT = 3, T_INPUT_ACK = 4,
                   T_QUAL_REQ = 5, T_QUAL_REP = 6, T_KEEP_ALIVE = 7,
                   T_CHECKSUM = 8, T_DISC_NOTICE = 9;
+/* wire protocol version, carried in SYNC_REQ/SYNC_REP after the nonce; a
+ * mismatched or missing version gets no reply, so mixed-version pairs stall
+ * in the handshake instead of mis-parsing each other's input rows (mirrors
+ * session/protocol.py PROTOCOL_VERSION — keep in sync) */
+constexpr uint8_t PROTOCOL_VERSION = 1;
 /* how long an adopted disconnect-consensus frame keeps rebroadcasting
  * (mirrors session/p2p.py DISC_NOTICE_REBROADCAST_S) */
 constexpr double DISC_NOTICE_REBROADCAST_S = 1.5;
@@ -217,7 +222,7 @@ struct Endpoint {
   }
 
   void send_sync_request() {
-    Writer b; b.u32(sync_nonce);
+    Writer b; b.u32(sync_nonce); b.u8(PROTOCOL_VERSION);
     last_sync_sent = now_s();
     send(T_SYNC_REQ, b);
   }
@@ -282,13 +287,17 @@ struct Endpoint {
     switch (t) {
       case T_SYNC_REQ: {
         uint32_t nonce = r.u32();
-        if (!r.ok) break;
-        Writer b; b.u32(nonce); send(T_SYNC_REP, b);
+        uint8_t ver = r.u8();
+        /* drop without replying on missing (pre-versioning 4-byte body) or
+         * mismatched version: the mixed-version pair must stall, not run */
+        if (!r.ok || ver != PROTOCOL_VERSION) break;
+        Writer b; b.u32(nonce); b.u8(PROTOCOL_VERSION); send(T_SYNC_REP, b);
         break;
       }
       case T_SYNC_REP: {
         uint32_t nonce = r.u32();
-        if (!r.ok) break;
+        uint8_t ver = r.u8();
+        if (!r.ok || ver != PROTOCOL_VERSION) break;
         if (state == GGRS_SYNCHRONIZING && nonce == sync_nonce) {
           sync_remaining--;
           sync_nonce = (uint32_t)(sync_nonce * 6364136223846793005ULL + 1ULL);
@@ -703,6 +712,9 @@ void ggrs_p2p_poll(GgrsP2P *s) {
       ep->have_base_inbox = false;
       ep->inbox.clear();
       ep->checksum_inbox.clear();
+      /* disc notices too: a dropped peer must not keep forcing consensus
+       * adoptions below (same staleness as its queued inputs) */
+      ep->disc_notice_inbox.clear();
     }
     if (ep->have_base_inbox) {
       ep->have_base_inbox = false;

@@ -3,6 +3,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== lint (unused imports) =="
+python scripts/lint_imports.py
+
 echo "== native build + tests =="
 make -C native
 make -C native test

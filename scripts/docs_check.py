@@ -5,7 +5,6 @@ function in bevy_ggrs_tpu must carry a docstring."""
 
 import ast
 import os
-import sys
 
 ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "bevy_ggrs_tpu")

@@ -7,7 +7,6 @@ Usage: BGT_PLATFORM=cpu python scripts/e2e_p2p_check.py [--ticks 60]
 """
 
 import argparse
-import sys
 import time
 
 from bevy_ggrs_tpu.utils.platform import apply_platform_env

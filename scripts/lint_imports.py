@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Fast lint gate for CI: unused imports and obvious bind errors.
+
+Prefers ``pyflakes`` when it is importable (full undefined-name analysis);
+otherwise falls back to a stdlib-``ast`` checker that catches the highest
+value class of drift in a growing codebase — imports nobody uses anymore —
+plus duplicate function/class definitions in the same scope.  Zero
+third-party dependencies by design (the container forbids installs).
+
+    python scripts/lint_imports.py [paths...]   # default: package+tests+scripts
+"""
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ("bevy_ggrs_tpu", "tests", "scripts", "bench.py")
+
+# re-export / intentional-import conventions that must not be flagged
+_ALLOW_UNUSED_IN = ("__init__.py",)
+
+
+def _names_loaded(tree: ast.AST) -> set:
+    """Every bare name and attribute-root referenced anywhere in the tree."""
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # walk to the root of a dotted access (os.path.join -> os)
+            inner = node.value
+            while isinstance(inner, ast.Attribute):
+                inner = inner.value
+            if isinstance(inner, ast.Name):
+                used.add(inner.id)
+    # names referenced inside string annotations / __all__ entries count
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)
+    return used
+
+
+def _check_file(path: Path) -> list:
+    """Return ``(line, message)`` problems found in one file."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    problems = []
+    used = _names_loaded(tree)
+    allow_unused = path.name in _ALLOW_UNUSED_IN
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue  # compiler directives, not bindings
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "noqa" in line or allow_unused:
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in used and bound != "_":
+                problems.append(
+                    (node.lineno, f"unused import: {alias.asname or alias.name}")
+                )
+    # duplicate top-level def/class bindings in the same scope shadow silently
+    for scope in ast.walk(tree):
+        if not isinstance(scope, (ast.Module, ast.ClassDef)):
+            continue
+        seen = {}
+        for stmt in scope.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                # any decorator exempts: @property/@x.setter pairs,
+                # @overload stacks, @pytest.fixture shadowing, ...
+                if stmt.name in seen and not stmt.decorator_list:
+                    problems.append(
+                        (stmt.lineno,
+                         f"duplicate definition of {stmt.name!r} "
+                         f"(first at line {seen[stmt.name]})")
+                    )
+                seen[stmt.name] = stmt.lineno
+    return problems
+
+
+def _iter_files(paths) -> list:
+    """Expand the path arguments into a sorted list of .py files."""
+    files = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    return files
+
+
+def main(argv) -> int:
+    """Lint the given paths; return a non-zero exit code on any finding."""
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    files = _iter_files(paths)
+    try:
+        from pyflakes.api import checkPath
+        from pyflakes.reporter import Reporter
+
+        rep = Reporter(sys.stdout, sys.stderr)
+        bad = sum(checkPath(str(f), rep) for f in files)
+        print(f"lint (pyflakes): {len(files)} files, {bad} problems")
+        return 1 if bad else 0
+    except ImportError:
+        pass
+    bad = 0
+    for f in files:
+        for lineno, msg in _check_file(f):
+            print(f"{f}:{lineno}: {msg}")
+            bad += 1
+    print(f"lint (stdlib ast): {len(files)} files, {bad} problems")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -80,11 +80,19 @@ def main():
     ap.add_argument("--ticks", type=int, default=50)
     ap.add_argument("--warmup", type=int, default=50)
     ap.add_argument("--logdir", default="/tmp/ggrs_trace")
+    ap.add_argument("--telemetry-out", default=None, metavar="PATH",
+                    help="enable telemetry and write the profiled ticks' "
+                         "timeline (spans, rollbacks, dispatches) as JSONL")
     args = ap.parse_args()
 
     import jax
 
     from bevy_ggrs_tpu.utils.tracing import clear_trace_events, get_trace_events
+
+    if args.telemetry_out:
+        from bevy_ggrs_tpu import telemetry
+
+        telemetry.enable()
 
     runners, deliver = build_runner(args.mode, args.entities,
                                     args.check_distance)
@@ -94,6 +102,8 @@ def main():
         for r in runners:
             r.tick()
 
+    if args.telemetry_out:
+        telemetry.reset()  # drop warmup events: export the profiled window only
     clear_trace_events()
     t0 = time.perf_counter()
     with runners[0].profile(args.logdir):
@@ -138,6 +148,9 @@ def main():
           f"on CPU)")
     print(f"device trace written to {args.logdir} (view with xprof/"
           f"tensorboard)")
+    if args.telemetry_out:
+        n = telemetry.export_jsonl(args.telemetry_out)
+        print(f"telemetry timeline: {n} events -> {args.telemetry_out}")
 
 
 if __name__ == "__main__":

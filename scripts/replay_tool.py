@@ -46,6 +46,10 @@ def cmd_checksums(args):
     from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
     from bevy_ggrs_tpu import models
 
+    if args.telemetry_out:
+        from bevy_ggrs_tpu import telemetry
+
+        telemetry.enable()
     rec = load(args.recording)
     app = getattr(models, args.model).make_app(num_players=rec.num_players)
     # bit-faithful replay requires the recorded canonical program config
@@ -59,6 +63,9 @@ def cmd_checksums(args):
                   f"{checksum_to_int(runner._world_checksum):#018x}")
     print(f"final frame {runner.frame}: "
           f"{checksum_to_int(runner._world_checksum):#018x}")
+    if args.telemetry_out:
+        n = telemetry.export_jsonl(args.telemetry_out)
+        print(f"telemetry timeline: {n} events -> {args.telemetry_out}")
 
 
 def cmd_diff(args):
@@ -86,6 +93,9 @@ def main():
     p.add_argument("recording")
     p.add_argument("--model", default="box_game")
     p.add_argument("--every", type=int, default=10)
+    p.add_argument("--telemetry-out", default=None, metavar="PATH",
+                   help="enable telemetry and write the replay's timeline "
+                        "(spans, rollbacks, dispatches) as JSONL")
     p = sub.add_parser("diff")
     p.add_argument("a")
     p.add_argument("b")

@@ -4,6 +4,11 @@ analog): hosts rooms, pushes rosters, relays datagrams for peers that
 cannot reach each other directly.
 
     python scripts/room_server.py --port 3536
+
+With ``--metrics-port`` the process also serves the telemetry registry as a
+Prometheus text endpoint (``GET /metrics`` — see docs/observability.md):
+
+    python scripts/room_server.py --port 3536 --metrics-port 9464
 """
 
 import argparse
@@ -12,6 +17,7 @@ import time
 
 sys.path.insert(0, ".")
 
+from bevy_ggrs_tpu import telemetry
 from bevy_ggrs_tpu.session.room import RoomServer
 
 
@@ -21,7 +27,21 @@ def main() -> None:
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--timeout", type=float, default=5.0,
                     help="member silence timeout (s)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics on this port "
+                         "(0 = any free port)")
+    ap.add_argument("--metrics-host", default="127.0.0.1")
     args = ap.parse_args()
+    exporter = None
+    if args.metrics_port is not None:
+        telemetry.enable()
+        exporter = telemetry.start_http_exporter(
+            port=args.metrics_port, host=args.metrics_host
+        )
+        print(
+            f"metrics on http://{args.metrics_host}:{exporter.port}/metrics",
+            flush=True,
+        )
     server = RoomServer(port=args.port, host=args.host,
                         member_timeout_s=args.timeout)
     print(f"room server on {server.local_addr}", flush=True)
@@ -36,6 +56,12 @@ def main() -> None:
                     room: sorted(members)
                     for room, members in server.rooms.items()
                 }
+                telemetry.gauge_set("room_count", len(rooms), "active rooms")
+                telemetry.gauge_set(
+                    "room_members",
+                    sum(len(m) for m in rooms.values()),
+                    "members across all rooms",
+                )
                 if rooms:
                     print(f"rooms: {rooms}", flush=True)
             time.sleep(0.002)
@@ -43,6 +69,8 @@ def main() -> None:
         pass
     finally:
         server.close()
+        if exporter is not None:
+            exporter.close()
 
 
 if __name__ == "__main__":

@@ -7,7 +7,21 @@ Note: the ambient environment may import jax at interpreter start (TPU tunnel
 sitecustomize) with JAX_PLATFORMS already set, so env vars are too late —
 update the jax config directly instead."""
 
+import os
+
+# jax < 0.5 has no jax_num_cpu_devices config option; the XLA flag is the
+# portable spelling and is read at backend init (first device use), so
+# setting it here is early enough even when jax was already imported
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS fallback above covers it

@@ -10,7 +10,6 @@ import time
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from bevy_ggrs_tpu import (
     App,

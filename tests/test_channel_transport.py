@@ -2,7 +2,6 @@
 channel network (the matchbox/WebRTC-analog socket swap) with deterministic
 latency — forces real predictions and rollbacks without real sockets."""
 
-import numpy as np
 
 from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
 from bevy_ggrs_tpu.models import box_game

@@ -13,7 +13,6 @@ from bevy_ggrs_tpu.snapshot import (
     checksum_to_int,
     spawn,
     despawn,
-    despawn_confirmed,
     insert_resource,
     remove_resource,
     world_checksum,

@@ -4,7 +4,6 @@ structure -> fixed float summation order per sharding... verified empirically
 on the CPU mesh; see model docstring for the cross-backend caveat)."""
 
 import numpy as np
-import pytest
 
 from bevy_ggrs_tpu import GgrsRunner, SyncTestSession
 from bevy_ggrs_tpu.models import crowd

@@ -4,8 +4,6 @@ parity_check.py on real hardware) across CPU/TPU backends."""
 
 import dataclasses
 
-import jax
-import jax
 import jax.numpy as jnp
 import numpy as np
 

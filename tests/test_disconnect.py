@@ -5,7 +5,6 @@ the reference's failure model (SURVEY §5.3)."""
 
 import time
 
-import numpy as np
 
 from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
 from bevy_ggrs_tpu.models import box_game

@@ -1,7 +1,6 @@
 """Input queue unit tests: delay, PredictRepeatLast, first-incorrect
 detection, redundancy dedup, gap prediction."""
 
-import numpy as np
 
 from bevy_ggrs_tpu.session.input_queue import InputQueue
 from bevy_ggrs_tpu.session.events import InputStatus

@@ -77,7 +77,6 @@ def test_two_process_distributed_mesh(tmp_path):
     assert sums[0] == sums[1], "ranks disagree"
 
     # same topology single-process: the integer model must match exactly
-    import jax
 
     from bevy_ggrs_tpu.models import fixed_point
     from bevy_ggrs_tpu.parallel import make_mesh, make_sharded_resim_fn

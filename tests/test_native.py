@@ -179,7 +179,8 @@ def test_native_stall_without_remote():
     r0 = make_native_runner(0, p0, p1, input_delay=0)
     # fake peer: reply to sync requests only, never send inputs
     from bevy_ggrs_tpu.session.protocol import (
-        HDR, MAGIC, S_SYNC_REP, S_SYNC_REQ, T_SYNC_REQ, T_SYNC_REP,
+        HDR, MAGIC, PROTOCOL_VERSION, S_SYNC_REP, S_SYNC_REQ,
+        T_SYNC_REQ, T_SYNC_REP,
     )
 
     sock = UdpNonBlockingSocket(p1, host="0.0.0.0")
@@ -188,9 +189,11 @@ def test_native_stall_without_remote():
         for addr, data in sock.receive_all():
             magic, t = HDR.unpack_from(data)
             if t == T_SYNC_REQ:
-                (nonce,) = S_SYNC_REQ.unpack_from(data[HDR.size:])
+                nonce, _ver = S_SYNC_REQ.unpack_from(data[HDR.size:])
                 sock.send_to(
-                    HDR.pack(MAGIC, T_SYNC_REP) + S_SYNC_REP.pack(nonce), addr
+                    HDR.pack(MAGIC, T_SYNC_REP)
+                    + S_SYNC_REP.pack(nonce, PROTOCOL_VERSION),
+                    addr,
                 )
         if r0.session.current_state() == SessionState.RUNNING:
             break
@@ -203,8 +206,6 @@ def test_native_stall_without_remote():
 
 
 def test_native_host_python_spectator():
-    from bevy_ggrs_tpu import SpectatorSession
-    from bevy_ggrs_tpu.session.events import PlayerType as PT
 
     p0, p1, p_spec = free_ports(3)
     # native host (streams to the spectator) + native peer

@@ -7,9 +7,6 @@ P2P+spectator trio works."""
 
 import time
 
-import jax.numpy as jnp
-import numpy as np
-import pytest
 
 from bevy_ggrs_tpu import (
     DesyncDetection,
@@ -170,15 +167,22 @@ def test_p2p_stalls_without_remote():
     session = b.start_p2p_session(socks[0])
     runner = GgrsRunner(app, session)
     # complete the sync handshake manually from the silent peer's socket
-    from bevy_ggrs_tpu.session.protocol import HDR, MAGIC, S_SYNC_REP, S_SYNC_REQ, T_SYNC_REQ, T_SYNC_REP
+    from bevy_ggrs_tpu.session.protocol import (
+        HDR, MAGIC, PROTOCOL_VERSION, S_SYNC_REP, S_SYNC_REQ,
+        T_SYNC_REQ, T_SYNC_REP,
+    )
 
     for _ in range(100):
         runner.update(0.0)
         for addr, data in socks[1].receive_all():
             magic, t = HDR.unpack_from(data)
             if t == T_SYNC_REQ:
-                (nonce,) = S_SYNC_REQ.unpack_from(data[HDR.size:])
-                socks[1].send_to(HDR.pack(MAGIC, T_SYNC_REP) + S_SYNC_REP.pack(nonce), addr)
+                nonce, _ver = S_SYNC_REQ.unpack_from(data[HDR.size:])
+                socks[1].send_to(
+                    HDR.pack(MAGIC, T_SYNC_REP)
+                    + S_SYNC_REP.pack(nonce, PROTOCOL_VERSION),
+                    addr,
+                )
         if session.current_state() == SessionState.RUNNING:
             break
         time.sleep(0.001)

@@ -2,9 +2,7 @@
 speculation must produce bit-identical checksums to single-device runs."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from bevy_ggrs_tpu.models import particles, box_game
 from bevy_ggrs_tpu.parallel import (

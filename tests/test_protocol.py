@@ -4,8 +4,6 @@ against malformed/truncated/alien packets."""
 
 import time
 
-import numpy as np
-import pytest
 
 from bevy_ggrs_tpu.session.events import (
     Disconnected,

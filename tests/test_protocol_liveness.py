@@ -11,7 +11,6 @@ demanded a rollback below the pruned ring.  Reference failure model:
 /root/reference/src (ggrs protocol's disconnect_timeout semantics).
 """
 
-import numpy as np
 
 from bevy_ggrs_tpu import GgrsRunner, PlayerType, SessionBuilder, SessionState
 from bevy_ggrs_tpu.models import box_game

@@ -2,7 +2,6 @@
 checksums (including from a mid-session checkpoint), and world checkpoints
 round-trip through disk exactly."""
 
-import os
 
 import numpy as np
 

@@ -12,9 +12,7 @@ import numpy as np
 
 from bevy_ggrs_tpu import App, GgrsRunner, SyncTestSession
 from bevy_ggrs_tpu.snapshot import (
-    active_mask,
     insert_resource,
-    remove_resource,
     spawn,
 )
 

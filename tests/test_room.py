@@ -7,7 +7,6 @@ assignment convention.  Reference contract: /root/reference/README.md:79
 
 import time
 
-import numpy as np
 import pytest
 
 from bevy_ggrs_tpu import (

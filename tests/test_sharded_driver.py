@@ -6,7 +6,6 @@ stack — session protocol, fused request dispatch, snapshot ring with lazy
 slices, rollback loads — with every component column sharded across the
 mesh's "data" axis (the SURVEY §2.4 tensor-parallel row, taken end-to-end)."""
 
-import jax
 import numpy as np
 
 from bevy_ggrs_tpu import GgrsRunner, SyncTestSession

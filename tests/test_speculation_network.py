@@ -2,7 +2,6 @@
 delay, predictions mispredict whenever inputs flip; a hedging runner must
 (a) hit its branch cache and (b) stay bit-identical to a non-hedging peer."""
 
-import numpy as np
 
 from bevy_ggrs_tpu import (
     GgrsRunner,

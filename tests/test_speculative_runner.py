@@ -3,7 +3,6 @@ rollback; with speculation enabled the corrected first frame must be served
 from the branch cache and produce EXACTLY the state a plain resim produces."""
 
 import numpy as np
-import pytest
 
 from bevy_ggrs_tpu import GgrsRunner, SessionState
 from bevy_ggrs_tpu.models import box_game

@@ -5,7 +5,6 @@ recursive despawn, spawn_many determinism, component/resource presence."""
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from bevy_ggrs_tpu.snapshot import (
     Registry,
