@@ -100,12 +100,18 @@ def _stage_setup():
     return jax
 
 
-def _bench_resim(app, n_players=2, iters=ITERS, reps=REPS, depth=DEPTH):
+def _bench_resim(app, n_players=2, iters=ITERS, reps=REPS, depth=DEPTH,
+                 warmup_reps=1):
     """Median-of-reps resim frames/s for one app; returns (median, spread).
 
     Uses the DONATING dispatch (what the driver issues): the carried state's
     buffers are reused in place by XLA, so each rep starts from a fresh
-    world (the previous rep's was consumed)."""
+    world (the previous rep's was consumed).
+
+    ``warmup_reps`` full UNTIMED reps run first (beyond the compile call):
+    the first timed windows used to absorb allocator/cache warmup, which was
+    the dominant term of ``spread_10k`` (0.258 in BENCH_r05) — the policy is
+    recorded in the stage JSON as ``rep_policy``."""
     import jax
     from bevy_ggrs_tpu.session.events import InputStatus
 
@@ -116,6 +122,12 @@ def _bench_resim(app, n_players=2, iters=ITERS, reps=REPS, depth=DEPTH):
     warm = app.init_state()
     final, stacked, checks = fn(warm, inputs, status, 0)
     jax.block_until_ready((final, stacked, checks))
+    for _ in range(warmup_reps):
+        w = app.init_state()
+        jax.block_until_ready(w)
+        for i in range(iters):
+            w, stacked, checks = fn(w, inputs, status, i * depth)
+        jax.block_until_ready(w)
     samples = []
     for _ in range(reps):
         w = app.init_state()
@@ -126,6 +138,11 @@ def _bench_resim(app, n_players=2, iters=ITERS, reps=REPS, depth=DEPTH):
         jax.block_until_ready(w)
         samples.append(depth * iters / (time.perf_counter() - t0))
     return _median_spread(samples)
+
+
+def _rep_policy(reps, warmup_reps, iters):
+    return {"reps": reps, "warmup_reps": warmup_reps, "iters": iters,
+            "stat": "median", "spread": "(max-min)/median"}
 
 
 def _state_bytes(app):
@@ -147,12 +164,13 @@ def stage_resim10k():
     from bevy_ggrs_tpu.models import stress_soa
 
     app = stress_soa.make_app(N_ENTITIES)
-    fps, spread = _bench_resim(app)
+    fps, spread = _bench_resim(app, warmup_reps=2)
     plat = jax.devices()[0].platform
     bpf = 3 * _state_bytes(app)  # step reads+writes + checksum re-read
     return {
         "fps_10k": round(fps, 1), "spread_10k": round(spread, 3),
         "layout_10k": "scalar_columns",
+        "rep_policy_10k": _rep_policy(REPS, 2, ITERS),
         "bytes_per_resim_frame": bpf,
         "hbm_pct_10k": _hbm_pct(fps, bpf, plat),
         "platform": plat,
@@ -188,39 +206,149 @@ def stage_resim1m():
 
 
 def stage_batched():
-    """Many-worlds: M independent 10k-entity lobbies, one vmapped dispatch
-    (the server shape that supersedes the reference's one-session-per-process
-    model, /root/reference/src/lib.rs:79-88).  Reports aggregate lobby-frames
-    per second and the per-lobby rate."""
+    """Many-worlds: M independent 10k-entity lobbies through the shape-
+    bucketed wave executor (the server shape that supersedes the reference's
+    one-session-per-process model, /root/reference/src/lib.rs:79-88).
+
+    Two parts:
+
+    1. THROUGHPUT — the same 16-lobby x 8-frame x 10k-entity workload as
+       BENCH_r05, dispatched through ``BucketedWaveExecutor`` exactly as the
+       server does for a full wave: the exact (unmasked) ``unroll=2`` program
+       with hoisted checksums and output recycling (previous wave's
+       stacked/checks buffers donated back to XLA).  Reports aggregate
+       lobby-frames/s (``batched_agg_fps_10k``).
+    2. DISPATCH GATE — a real ``BatchedRunner`` drives M lockstep SyncTest
+       lobbies at M=4 and M=16 with telemetry on; the stage HARD-FAILS
+       (raises -> nonzero exit) unless the steady-state device-dispatch
+       count per tick is identical at both lobby counts (O(1) in M).
+       Reports ``device_dispatches_per_tick``, the bucket histogram and the
+       executor compile count.
+
+    ``BGT_BENCH_SMOKE=1`` shrinks both parts to a seconds-long CI smoke run
+    (1 rep; the gate is unchanged — it is the point of the smoke)."""
     jax = _stage_setup()
     from bevy_ggrs_tpu.models import stress_soa
-    from bevy_ggrs_tpu.ops.batch import make_batched_resim_fn, stack_worlds
+    from bevy_ggrs_tpu.ops.batch import BucketedWaveExecutor, stack_worlds
     from bevy_ggrs_tpu.session.events import InputStatus
 
+    smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
+    reps = 1 if smoke else REPS
+    iters = 5 if smoke else ITERS
+    warmup_reps = 1 if smoke else 2
+
     app = stress_soa.make_app(N_ENTITIES)
-    fn = make_batched_resim_fn(app)
+    ex = BucketedWaveExecutor(app, DEPTH, recycle_outputs=True)
     worlds = stack_worlds([app.init_state() for _ in range(LOBBIES)])
     inputs = np.zeros((LOBBIES, DEPTH, 2), np.uint8)
     status = np.full((LOBBIES, DEPTH, 2), InputStatus.CONFIRMED, np.int8)
     frames = np.zeros((LOBBIES,), np.int32)
-    out = fn(worlds, inputs, status, frames)
-    jax.block_until_ready(out)
-    samples = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        w = worlds
-        for i in range(ITERS):
-            w, stacked, checks = fn(w, inputs, status, frames + i * DEPTH)
-        jax.block_until_ready(w)
-        samples.append(LOBBIES * DEPTH * ITERS / (time.perf_counter() - t0))
-    agg, spread = _median_spread(samples)
+    ks = [DEPTH] * LOBBIES
+
+    def run_reps(n, timed):
+        nonlocal worlds
+        out = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            w = worlds
+            for i in range(iters):
+                _bkt, w, _stacked, _checks = ex.run_wave(
+                    w, inputs, status, frames + i * DEPTH, ks
+                )
+            jax.block_until_ready(w)
+            if timed:
+                out.append(
+                    LOBBIES * DEPTH * iters / (time.perf_counter() - t0)
+                )
+        return out
+
+    run_reps(warmup_reps, timed=False)  # compiles + allocator warmup
+    agg, spread = _median_spread(run_reps(reps, timed=True))
+
+    gate = _dispatch_flatness_gate(smoke)
     plat = jax.devices()[0].platform
     return {
         "batched_lobbies": LOBBIES,
         "batched_agg_fps_10k": round(agg, 1),
         "batched_per_lobby_fps_10k": round(agg / LOBBIES, 1),
         "batched_spread": round(spread, 3),
+        "batched_rep_policy": _rep_policy(reps, warmup_reps, iters),
+        "batched_executor": {
+            "unroll": ex.unroll, "fused_checksums": ex.fused_checksums,
+            "recycle_outputs": ex.recycle_outputs,
+            "buckets": list(ex.buckets),
+        },
+        **gate,
         "platform": plat,
+    }
+
+
+def _dispatch_flatness_gate(smoke: bool) -> dict:
+    """Drive a real BatchedRunner at M=4 and M=16 lockstep SyncTest lobbies
+    and HARD-FAIL unless device dispatches per steady-state tick are equal
+    (the O(1)-in-M acceptance gate).  Telemetry is enabled so the reported
+    dispatch/compile counts come from the registry, not ad-hoc ints."""
+    from bevy_ggrs_tpu import BatchedRunner, SyncTestSession, telemetry
+    from bevy_ggrs_tpu.models import stress
+
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    warm, meas = (2, 4) if smoke else (4, 8)
+    per_tick = {}
+    hist = compiles = jit_entries = None
+    for m in (4, 16):
+        app = stress.make_app(64, capacity=64)
+        sessions = [
+            SyncTestSession(num_players=2, input_shape=(),
+                            input_dtype=np.uint8, check_distance=2,
+                            compare_interval=1)
+            for _ in range(m)
+        ]
+        br = BatchedRunner(
+            app, sessions,
+            read_inputs=lambda lobby, handles: {
+                h: np.uint8((lobby + h) & 0xF) for h in handles
+            },
+        )
+        for _ in range(warm):
+            br.tick()
+        d0 = br.device_dispatches
+        for _ in range(meas):
+            br.tick()
+        br.finish()
+        per_tick[m] = (br.device_dispatches - d0) / meas
+        if m == 16:
+            s = br.stats()
+            hist = s["bucket_hist"]
+            compiles = s["program_compiles"]
+            jit_entries = s["jit_entries"]
+    reg = telemetry.registry()
+    tel = {
+        "wave_dispatches_total": reg.counter(
+            "batched_wave_dispatches_total").value(),
+        "program_compiles_total": reg.counter(
+            "batched_program_compiles_total").value(),
+        "device_dispatches_total": reg.counter(
+            "device_dispatches_total").value(),
+        "fused_load_dispatches_total": reg.counter(
+            "fused_load_dispatches_total").value(),
+        "fallback_load_rows_total": reg.counter(
+            "fallback_load_rows_total").value(),
+    }
+    telemetry.disable()
+    telemetry.reset()
+    if per_tick[4] != per_tick[16]:
+        raise RuntimeError(
+            "O(1)-dispatch gate FAILED: device dispatches per tick scale "
+            f"with lobby count: {per_tick}"
+        )
+    return {
+        "device_dispatches_per_tick": {str(m): v for m, v in per_tick.items()},
+        "batched_bucket_hist": {str(k): v for k, v in (hist or {}).items()},
+        "batched_program_compiles": compiles,
+        "batched_jit_entries": jit_entries,
+        "batched_telemetry": tel,
     }
 
 
@@ -388,9 +516,25 @@ def _probe_backend(timeout_s: int = 120) -> bool:
         return False
 
 
-def _run_stage(name: str, timeout_s: int, force_cpu: bool):
+# glibc malloc tuning for the stage subprocesses: the stacked resim outputs
+# are tens-of-MB buffers that default-malloc serves via mmap and returns to
+# the kernel every free — page-fault churn worth ~8% of batched agg fps on
+# the 1-CPU bench host.  Keeping them on the heap (1 GB thresholds) lets
+# XLA's allocator actually reuse them.  Recorded in the suite JSON as
+# ``bench_env``.
+BENCH_MALLOC_ENV = {
+    "MALLOC_MMAP_THRESHOLD_": str(1 << 30),
+    "MALLOC_TRIM_THRESHOLD_": str(1 << 30),
+}
+
+
+def _run_stage(name: str, timeout_s: int, force_cpu: bool, extra_env=None):
     """Run one stage subprocess; returns (result_dict | None, error | None)."""
     env = dict(os.environ)
+    for k, v in BENCH_MALLOC_ENV.items():
+        env.setdefault(k, v)
+    if extra_env:
+        env.update(extra_env)
     if force_cpu:
         env["BGT_PLATFORM"] = "cpu"
     try:
@@ -513,6 +657,16 @@ def orchestrate():
         "batched_per_lobby_fps_10k": merged.get("batched_per_lobby_fps_10k"),
         "batched_agg_vs_baseline": div(merged.get("batched_agg_fps_10k"),
                                        base10k),
+        "batched_spread": merged.get("batched_spread"),
+        "batched_rep_policy": merged.get("batched_rep_policy"),
+        "batched_executor": merged.get("batched_executor"),
+        "device_dispatches_per_tick": merged.get("device_dispatches_per_tick"),
+        "batched_bucket_hist": merged.get("batched_bucket_hist"),
+        "batched_program_compiles": merged.get("batched_program_compiles"),
+        "batched_jit_entries": merged.get("batched_jit_entries"),
+        "batched_telemetry": merged.get("batched_telemetry"),
+        "rep_policy_10k": merged.get("rep_policy_10k"),
+        "bench_env": BENCH_MALLOC_ENV,
         "speculative_lane0_useful_fps": merged.get("spec_fps"),
         "speculative_lane_frames_per_sec": rnd(
             (merged.get("spec_fps") or 0) * SPEC_BRANCHES or None),
@@ -545,15 +699,34 @@ def orchestrate():
     print(json.dumps(result))
 
 
+def smoke():
+    """CI smoke: the batched stage only, 1 rep, small iter counts — seconds,
+    not minutes — with the O(1)-dispatch gate fully armed (a dispatch-count
+    regression fails this run).  Wired into scripts/check.sh."""
+    result, err = _run_stage(
+        "batched", timeout_s=300, force_cpu=False,
+        extra_env={"BGT_BENCH_SMOKE": "1"},
+    )
+    if result is None:
+        print(f"bench smoke FAILED: {err}", file=sys.stderr)
+        sys.exit(1)
+    print(json.dumps({"smoke": "ok", **result}))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", choices=sorted(STAGES), default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="batched stage only, 1 rep, dispatch gate armed")
     args = ap.parse_args()
     if args.stage:
         from bevy_ggrs_tpu.utils.platform import apply_platform_env
 
         apply_platform_env()
         print(json.dumps(STAGES[args.stage][0]()))
+        return
+    if args.smoke:
+        smoke()
         return
     orchestrate()
 

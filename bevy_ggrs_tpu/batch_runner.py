@@ -16,15 +16,24 @@ world.  Each server tick it:
    ``Load(frame)`` / ``Run([Save|Advance ...])`` — exactly the segments
    GgrsRunner fuses per lobby (runner.py _handle_requests);
 3. executes ops positionally as WAVES across lobbies: wave w batches every
-   lobby's w-th Run into ONE ``jit(vmap(resim_padded))`` dispatch
-   (per-lobby ``n_real`` masks; idle lanes pass through), and serves Load
-   ops host-side from per-lobby snapshot rings (with a fused gather path
-   when every lobby loads out of the SAME past dispatch's stacked buffer —
-   the lockstep-SyncTest shape).
+   lobby's w-th Run into ONE dispatch through the shape-bucketed executor
+   (ops/batch.BucketedWaveExecutor: smallest power-of-two depth bucket
+   covering the wave's ``k_hot``, exact unmasked program for full waves,
+   ``n_real``-masked program for ragged ones), and serves Load ops from
+   per-lobby snapshot rings via ONE fused mixed-source gather — lobbies
+   loading rows of *different* past stacked buffers are grouped per buffer
+   (snapshot/lazy.plan_row_gather) and scattered into the resident world in
+   a single jitted program.
 
-Saves store ``LazySlice(stacked, (lobby, frame_idx))`` handles — one
-``[M, K, ...]`` buffer per wave backs every lobby's ring rows, and checksum
-pulls ride the process-wide BatchChecks fusion (snapshot/lazy.py).
+The steady-state tick therefore costs a CONSTANT number of device
+dispatches independent of the lobby count M (one per load wave, one per
+run wave, plus one fused ``store_state`` dispatch for non-identity
+strategies) — verified by the dispatch-flatness gate in bench.py's batched
+stage.  Inputs stage through persistent preallocated host buffers (no
+per-tick allocation), and saves store ``LazySlice(stacked, (lobby,
+frame_idx))`` handles — one ``[M, K, ...]`` buffer per wave backs every
+lobby's ring rows, with checksum pulls riding the process-wide BatchChecks
+fusion (snapshot/lazy.py).
 
 Bit-equality caveat (same as ops/batch.py): the vmapped program is a
 DIFFERENT XLA program than the single-lobby one, so for variant-unstable
@@ -43,8 +52,7 @@ import numpy as np
 
 from . import telemetry
 from .app import App
-from .ops.batch import make_batched_padded_fn, stack_worlds
-from .ops.resim import pad_repeat_last
+from .ops.batch import BucketedWaveExecutor, stack_worlds
 from .session.events import (
     DesyncDetected,
     MismatchedChecksumError,
@@ -54,8 +62,15 @@ from .session.events import (
 )
 from .session.requests import AdvanceRequest, GgrsRequest, LoadRequest, SaveRequest
 from .session.synctest import SyncTestSession
-from .snapshot.lazy import BatchChecks, LazySlice, materialize
-from .snapshot.ring import SnapshotRing
+from .snapshot.lazy import (
+    BatchChecks,
+    LazySlice,
+    fused_gather_rows,
+    fused_load_rows,
+    materialize,
+    plan_row_gather,
+)
+from .snapshot.ring import SnapshotRing, rollback_many
 from .utils.frames import NULL_FRAME, frame_add
 from .utils.tracing import span
 
@@ -131,7 +146,12 @@ class BatchedRunner:
         self.on_mismatch = on_mismatch
         self.on_event = on_event
         self.worlds = stack_worlds([app.init_state() for _ in range(m)])
-        self.fn = make_batched_padded_fn(app, self.k_max)
+        # shape-bucketed wave programs replace the single k_max-deep padded
+        # fn: a 1-advance lockstep wave dispatches the exact k=1 program, a
+        # ragged rollback wave the smallest masked bucket covering it.
+        # recycle_outputs stays OFF here — the rings below hold LazySlice
+        # handles into past stacked outputs, so they must never be donated.
+        self.exec = BucketedWaveExecutor(app, self.k_max)
         # per-lobby live-world checksum handles (ONE vmapped dispatch for
         # all M rows; leading saves reuse these instead of dispatching)
         import jax as _jax
@@ -149,21 +169,65 @@ class BatchedRunner:
         self.ticks = 0
         self.rollbacks = 0
         self.device_dispatches = 0
+        self.fused_loads = 0
+        self.fallback_loads = 0
         self.stalled = [0] * m
         self._np = self.sessions[0].num_players()
         for s in self.sessions:
             if s.num_players() != self._np:
                 raise ValueError("all lobbies must share num_players "
                                  "(one batched input tensor)")
+        # persistent staging: the per-tick input/status tensors are filled in
+        # place every wave instead of re-allocated (allocation churn was a
+        # measurable slice of the 1-CPU-host tick).  Idle/padded lanes keep
+        # stale rows — the padded program's n_real mask discards them, the
+        # exact program never sees them.
+        self._stage_inputs = np.zeros(
+            (m, self.k_max, self._np, *app.input_shape), app.input_dtype
+        )
+        self._stage_status = np.zeros((m, self.k_max, self._np), np.int8)
+        self._stage_starts = np.zeros((m,), np.int32)
+        # stable bound-method refs: snapshot-strategy hooks fused into the
+        # batched load/save programs (and the jit-cache keys of
+        # fused_load_rows / fused_gather_rows)
+        if self.app.reg.is_identity_strategy():
+            self._load_transform = None
+            self._store_transform = None
+        else:
+            self._load_transform = self.app.reg.load_state
+            self._store_transform = self.app.reg.store_state
+        # pre-bound argument-free counters: name+help registered ONCE here,
+        # per-tick increments are attribute checks (not dict/string traffic)
+        _treg = telemetry.registry()
+        self._m_ticks = _treg.bind_counter(
+            "server_ticks_total", "batched-server ticks (all lobbies)"
+        )
+        self._m_dispatches = _treg.bind_counter(
+            "device_dispatches_total",
+            "fused device dispatches (resim + load + store waves)",
+        )
+        self._m_resim_frames = _treg.bind_counter(
+            "resim_frames_total",
+            "frames resimulated beyond the first of each dispatch",
+        )
+        self._m_rollbacks = _treg.bind_counter(
+            "rollbacks_total", "LoadRequests executed"
+        )
+        self._m_fused_loads = _treg.bind_counter(
+            "fused_load_dispatches_total",
+            "load waves served by one mixed-source gather",
+        )
+        self._m_fallback_loads = _treg.bind_counter(
+            "fallback_load_rows_total",
+            "load rows served by per-lobby scatter (non-LazySlice snapshot)",
+        )
 
     # -- per-tick driver ----------------------------------------------------
 
     def tick(self) -> None:
         """One server tick: poll + step every lobby, flush as waves."""
         self.ticks += 1
-        telemetry.count(
-            "server_ticks_total", help="batched-server ticks (all lobbies)"
-        )
+        self._m_ticks.inc()
         per_lobby_ops: List[List[_Op]] = []
         for b, s in enumerate(self.sessions):
             per_lobby_ops.append(self._collect_ops(b, s))
@@ -226,7 +290,7 @@ class BatchedRunner:
             telemetry.count(
                 "stalled_frames_total", help="ticks skipped on stall",
                 kind="p2p", lobby=b,
-            )
+            )  # cold path (exceptional), help re-pass is fine here
             telemetry.record("stall", lobby=b, frame=self.frames[b],
                              reason="prediction_threshold")
             return []
@@ -247,55 +311,45 @@ class BatchedRunner:
         self.rollbacks += len(loads)
         if telemetry.enabled():
             for b, f in loads:
-                telemetry.count("rollbacks_total", help="LoadRequests executed",
-                                lobby=b)
+                telemetry.count("rollbacks_total", lobby=b)
                 telemetry.observe(
-                    "rollback_depth", self.frames[b] - f,
-                    "frames rolled back per LoadRequest", lobby=b,
+                    "rollback_depth", self.frames[b] - f, lobby=b,
                 )
                 telemetry.record("rollback", lobby=b, to_frame=f,
                                  from_frame=self.frames[b],
                                  depth=self.frames[b] - f)
         with span("LoadWorldBatched"):
-            fused = self._try_fused_load(loads)
-            if fused is not None:
-                self.worlds = fused
-                for b, f in loads:
-                    _, cs = self.rings[b].rollback(f)
-                    self._world_checksum[b] = cs
-            else:
-                for b, f in loads:
-                    stored, cs = self.rings[b].rollback(f)
-                    state = self.app.reg.load_state(materialize(stored))
-                    self.worlds = _set_row(self.worlds, b, state)
-                    self._world_checksum[b] = cs
+            # batched mixed-source load: roll every ring back, group the
+            # stored LazySlice handles by backing stacked buffer, and serve
+            # the whole wave — even when lobbies load from DIFFERENT past
+            # dispatches' buffers — as ONE jitted gather+scatter.  A
+            # non-identity strategy's load_state hook is vmapped into the
+            # same program.
+            entries = rollback_many(self.rings, loads)
+            groups, fallback = plan_row_gather(
+                [(b, stored) for b, (stored, _cs) in entries]
+            )
+            if groups:
+                self.worlds = fused_load_rows(
+                    self.worlds, groups, self._load_transform
+                )
+                self.device_dispatches += 1
+                self.fused_loads += 1
+                self._m_dispatches.inc()
+                self._m_fused_loads.inc()
+            for b, stored in fallback:
+                # rare path: a ring entry that is a concrete pytree (not a
+                # LazySlice into a stacked buffer) — per-lobby scatter
+                state = self.app.reg.load_state(materialize(stored))
+                self.worlds = _set_row(self.worlds, b, state)
+                self.device_dispatches += 1
+                self.fallback_loads += 1
+                self._m_dispatches.inc()
+                self._m_fallback_loads.inc()
+            for b, (_stored, cs) in entries:
+                self._world_checksum[b] = cs
             for b, f in loads:
                 self.frames[b] = f
-
-    def _try_fused_load(self, loads):
-        """Lockstep fast path: every lobby rolls back to a row of the SAME
-        past dispatch's ``[M, K, ...]`` stacked buffer at the same frame
-        index, with lane == lobby (the M-identical-SyncTest shape) — one
-        gather replaces M scatters."""
-        if len(loads) != len(self.sessions):
-            return None
-        if not self.app.reg.is_identity_strategy():
-            return None
-        src = None
-        idx = None
-        for b, f in loads:
-            stored, _ = self.rings[b].rollback(f)
-            if not (isinstance(stored, LazySlice)
-                    and isinstance(stored._i, tuple)):
-                return None
-            bb, ii = stored._i
-            if bb != b:
-                return None
-            if src is None:
-                src, idx = stored._stacked, ii
-            elif stored._stacked is not src or ii != idx:
-                return None
-        return _gather_frame(src, idx)
 
     # -- runs ---------------------------------------------------------------
 
@@ -317,38 +371,39 @@ class BatchedRunner:
             )
         identity = self.app.reg.is_identity_strategy()
         stacked = batch = None
+        bucket = 0
         pre_checksum = list(self._world_checksum)
         prev_worlds = self.worlds
         if k_hot > 0:
-            inputs = np.zeros(
-                (m, self.k_max, self._np, *self.app.input_shape),
-                self.app.input_dtype,
-            )
-            status = np.zeros((m, self.k_max, self._np), np.int8)
-            n_real = np.zeros((m,), np.int32)
-            starts = np.asarray(self.frames, np.int32)
+            bucket = self.exec.bucket_for(k_hot)
+            # persistent staging fill (no per-tick allocation): write each
+            # lobby's rows in place, repeat the last real row through the
+            # bucket tail (padding inputs never affect results — masked by
+            # n_real — but keeping them finite avoids garbage-driven traps)
+            inputs, status = self._stage_inputs, self._stage_status
+            starts = self._stage_starts
+            starts[:] = self.frames
             for b, a in enumerate(adv):
-                if not a:
+                kb = len(a)
+                if not kb:
                     continue
-                seq = np.stack([x.inputs for x in a])
-                st = np.stack([x.status for x in a])
-                inputs[b] = pad_repeat_last(seq, self.k_max - len(a))
-                status[b] = pad_repeat_last(st, self.k_max - len(a))
-                n_real[b] = len(a)
+                bi, bs = inputs[b], status[b]
+                for i, x in enumerate(a):
+                    bi[i] = x.inputs
+                    bs[i] = x.status
+                if kb < bucket:
+                    bi[kb:bucket] = bi[kb - 1]
+                    bs[kb:bucket] = bs[kb - 1]
             self.device_dispatches += 1
-            telemetry.count("device_dispatches_total",
-                            help="fused resim dispatches")
-            telemetry.count(
-                "resim_frames_total", sum(max(k - 1, 0) for k in ks),
-                help="frames resimulated beyond the first of each dispatch",
-            )
+            self._m_dispatches.inc()
+            self._m_resim_frames.inc(sum(max(k - 1, 0) for k in ks))
             telemetry.record(
                 "dispatch", batched=True, k_hot=k_hot,
                 active_lobbies=sum(1 for k in ks if k > 0),
             )
             with span("AdvanceWorldBatched"):
-                finals, stacked, checks_flat = self.fn(
-                    self.worlds, inputs, status, starts, n_real
+                bucket, finals, stacked, checks_flat = self.exec.run_wave(
+                    self.worlds, inputs, status, starts, ks
                 )
                 batch = BatchChecks(checks_flat)
                 self.worlds = finals
@@ -356,9 +411,11 @@ class BatchedRunner:
                     if ks[b] > 0:
                         self.frames[b] = frame_add(self.frames[b], ks[b])
                         self._world_checksum[b] = batch.ref(
-                            b * self.k_max + ks[b] - 1
+                            b * bucket + ks[b] - 1
                         )
         with span("SaveWorldBatched"):
+            # collect this wave's saves as (lobby, advance-count-before, req)
+            saves = []
             for b, run in enumerate(runs):
                 if not run:
                     continue
@@ -366,23 +423,43 @@ class BatchedRunner:
                 for r in run:
                     if isinstance(r, AdvanceRequest):
                         c += 1
-                        continue
-                    if c == 0:
-                        # pre-dispatch save: slice the PREVIOUS resident
-                        # world's row (still alive in prev_worlds); its
-                        # checksum handle was tracked, not recomputed
-                        state_s = LazySlice(prev_worlds, b)
-                        cs = pre_checksum[b]
                     else:
-                        cs = batch.ref(b * self.k_max + (c - 1))
-                        state_s = LazySlice(stacked, (b, c - 1))
-                    stored = (
-                        state_s
-                        if identity
-                        else self.app.reg.store_state(state_s.materialize())
-                    )
-                    self.rings[b].push(r.frame, (stored, cs))
-                    r.cell.save(r.frame, cs.to_int)
+                        saves.append((b, c, r))
+            if not saves:
+                return
+            handles = []
+            for b, c, _r in saves:
+                if c == 0:
+                    # pre-dispatch save: slice the PREVIOUS resident world's
+                    # row (still alive in prev_worlds); its checksum handle
+                    # was tracked, not recomputed
+                    handles.append(LazySlice(prev_worlds, b))
+                else:
+                    handles.append(LazySlice(stacked, (b, c - 1)))
+            if not identity:
+                # one-dispatch non-identity saves: gather every saved row
+                # (mixed prev_worlds / stacked sources) and vmap the
+                # strategy's store_state over them in ONE jitted program;
+                # ring entries become LazySlice handles into the fused
+                # stored stack instead of M materialized pytrees
+                groups, _none = plan_row_gather(list(enumerate(handles)))
+                stored_stack = fused_gather_rows(groups, self._store_transform)
+                order = np.concatenate([g[3] for g in groups])
+                pos = np.empty_like(order)
+                pos[order] = np.arange(len(order), dtype=order.dtype)
+                handles = [
+                    LazySlice(stored_stack, int(pos[j]))
+                    for j in range(len(saves))
+                ]
+                self.device_dispatches += 1
+                self._m_dispatches.inc()
+            for (b, c, r), stored in zip(saves, handles):
+                cs = (
+                    pre_checksum[b] if c == 0
+                    else batch.ref(b * bucket + (c - 1))
+                )
+                self.rings[b].push(r.frame, (stored, cs))
+                r.cell.save(r.frame, cs.to_int)
 
     # -- observability ------------------------------------------------------
 
@@ -401,15 +478,22 @@ class BatchedRunner:
             )
 
     def stats(self) -> dict:
-        return {
+        """Driver + executor counters: ticks, rollbacks, device dispatches,
+        fused/fallback load counts, per-lobby frame state, and the wave
+        executor's compile/dispatch/bucket histogram stats."""
+        out = {
             "lobbies": len(self.sessions),
             "ticks": self.ticks,
             "rollbacks": self.rollbacks,
             "device_dispatches": self.device_dispatches,
+            "fused_loads": self.fused_loads,
+            "fallback_loads": self.fallback_loads,
             "stalled_frames": list(self.stalled),
             "frames": list(self.frames),
             "confirmed": list(self.confirmed),
         }
+        out.update(self.exec.stats())
+        return out
 
     def lobby_world(self, b: int):
         """Materialize lobby ``b``'s live world (one gather dispatch)."""
@@ -440,7 +524,6 @@ class BatchedRunner:
 
 _row_jit = None
 _set_row_jit = None
-_gather_frame_jit = None
 
 
 def _row(tree, b: int):
@@ -461,15 +544,3 @@ def _set_row(tree, b: int, row):
             lambda t, i, r: jax.tree.map(lambda a, x: a.at[i].set(x), t, r)
         )
     return _set_row_jit(tree, np.int32(b), row)
-
-
-def _gather_frame(stacked, i: int):
-    """[M, K, ...] stacked -> [M, ...] at frame index i (lockstep load)."""
-    global _gather_frame_jit
-    import jax
-
-    if _gather_frame_jit is None:
-        _gather_frame_jit = jax.jit(
-            lambda t, ii: jax.tree.map(lambda a: a[:, ii], t)
-        )
-    return _gather_frame_jit(stacked, np.int32(i))

@@ -118,12 +118,26 @@ def resim(
     retention: int,
     fps: int,
     seed: int = 0,
+    *,
+    unroll: int = 1,
+    fused_checksums: bool = False,
 ) -> Tuple[WorldState, WorldState, jnp.ndarray]:
     """Advance ``k`` frames in one fused scan.
 
     Returns ``(final_state, stacked_states, checksums)`` where
     ``stacked_states`` holds the state *after* each advance (leading axis k —
-    the per-frame SaveWorld outputs) and ``checksums`` is uint32[k, 2]."""
+    the per-frame SaveWorld outputs) and ``checksums`` is uint32[k, 2].
+
+    ``unroll`` forwards to ``lax.scan`` (the default 1 is the program the
+    solo runner has always dispatched); ``fused_checksums=False`` likewise
+    keeps the historical in-scan checksum placement.  With
+    ``fused_checksums=True`` the per-frame checksums are hoisted OUT of the
+    scan into one vmapped post-pass over the stacked output — bit-identical
+    by construction because :func:`..snapshot.checksum.world_checksum` is a
+    uint32 wrapping-add reduction (exactly associative/commutative, no float
+    rounding to reassociate), and measurably faster on CPU where the scan
+    body is memory-bound.  Batched program builders (ops/batch.py) use both
+    knobs; solo paths keep the defaults so recorded sims replay unchanged."""
     start_frame = jnp.asarray(start_frame, jnp.int32)
 
     def body(carry, x):
@@ -131,11 +145,17 @@ def resim(
         inp, stat = x
         nf = f + 1  # AdvanceFrame increments, then steps
         st = advance(reg, step_fn, st, inp, stat, nf, retention, fps, seed)
-        return (st, nf), (st, world_checksum(reg, st))
+        out = st if fused_checksums else (st, world_checksum(reg, st))
+        return (st, nf), out
 
-    (final, _), (stacked, checks) = jax.lax.scan(
-        body, (state, start_frame), (inputs_seq, status_seq)
+    (final, _), outs = jax.lax.scan(
+        body, (state, start_frame), (inputs_seq, status_seq), unroll=unroll
     )
+    if fused_checksums:
+        stacked = outs
+        checks = jax.vmap(lambda w: world_checksum(reg, w))(stacked)
+    else:
+        stacked, checks = outs
     return final, stacked, checks
 
 
@@ -150,6 +170,9 @@ def resim_padded(
     retention: int,
     fps: int,
     seed: int = 0,
+    *,
+    unroll: int = 1,
+    fused_checksums: bool = False,
 ):
     """Fixed-length scan with masked padding — the bit-determinism program.
 
@@ -160,7 +183,12 @@ def resim_padded(
     float bits and desync.  Running EVERY advance through one fixed-k_max
     program — real frames first, padded frames passing state through
     unchanged — makes the arithmetic identical regardless of segmentation.
-    See docs/determinism.md ("One program to advance them all")."""
+    See docs/determinism.md ("One program to advance them all").
+
+    ``unroll``/``fused_checksums`` as in :func:`resim` (defaults reproduce
+    the historical program; the hoisted checksum post-pass reads the
+    post-``where`` stacked rows, so padded lanes checksum the carried state
+    exactly as the in-scan placement did)."""
     start_frame = jnp.asarray(start_frame, jnp.int32)
     n_real = jnp.asarray(n_real, jnp.int32)
 
@@ -172,11 +200,18 @@ def resim_padded(
         take = i < n_real
         st = jax.tree.map(lambda a, b: jnp.where(take, a, b), st2, st)
         f = jnp.where(take, nf, f)
-        return (st, f, i + 1), (st, world_checksum(reg, st))
+        out = st if fused_checksums else (st, world_checksum(reg, st))
+        return (st, f, i + 1), out
 
-    (final, _, _), (stacked, checks) = jax.lax.scan(
-        body, (state, start_frame, jnp.int32(0)), (inputs_seq, status_seq)
+    (final, _, _), outs = jax.lax.scan(
+        body, (state, start_frame, jnp.int32(0)), (inputs_seq, status_seq),
+        unroll=unroll,
     )
+    if fused_checksums:
+        stacked = outs
+        checks = jax.vmap(lambda w: world_checksum(reg, w))(stacked)
+    else:
+        stacked, checks = outs
     return final, stacked, checks
 
 

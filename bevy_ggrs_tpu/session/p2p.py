@@ -474,7 +474,9 @@ class P2PSession:
             if not ep.disconnected:
                 # consistency over liveness (GGPO): a peer the others
                 # dropped is dropped here too, immediately — otherwise we
-                # would keep confirming inputs the survivors will never see
+                # would keep confirming inputs the survivors will never see.
+                # UNAUTHENTICATED by design: trusted-peer model, see
+                # docs/architecture.md "Trust model (networking)"
                 ep.disconnected = True
                 ep.events.append(Disconnected(dead_addr))
                 self._disc_corrected.add(dead_addr)

@@ -143,6 +143,8 @@ class RoomServer:
         r = _Reader(data[_HDR.size:])
         now = time.monotonic()
         if t == _JOIN:
+            # membership is claimed, not authenticated (trusted-network
+            # model — docs/architecture.md "Trust model (networking)")
             room, peer = r.s(), r.s()
             if not r.ok or not room or not peer:
                 return

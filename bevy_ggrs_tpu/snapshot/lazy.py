@@ -184,6 +184,136 @@ def tree_index(stacked, i: int):
 _tree_index_jit = None
 
 
+def plan_row_gather(handles):
+    """Group ``(target_row, snapshot)`` pairs by backing stacked buffer for
+    one fused gather — the planning half of the BatchedRunner's mixed-source
+    load/save paths.
+
+    Each :class:`LazySlice` names a row of some stacked dispatch output —
+    ``stacked[i]`` or ``stacked[b, i]``.  A wave where different lobbies load
+    from DIFFERENT buffers (staggered rollbacks, partially-idle lobbies) used
+    to fall back to one gather + one scatter dispatch per lobby; grouping the
+    handles by ``id(buffer)`` turns the whole wave into one jitted program
+    over a handful of source buffers (:func:`fused_load_rows` /
+    :func:`fused_gather_rows`).
+
+    Returns ``(groups, fallback)``: ``groups`` is a list of
+    ``(buffer, lanes_i32[n], idxs_i32[n] | None, targets_i32[n])`` in
+    first-seen order (deterministic given the handle order, which keeps the
+    jit cache warm across ticks with the same wave shape); ``fallback``
+    collects non-LazySlice snapshots for the caller's slow path."""
+    by = {}
+    order = []
+    fallback = []
+    for tgt, stored in handles:
+        if not isinstance(stored, LazySlice):
+            fallback.append((tgt, stored))
+            continue
+        if isinstance(stored._i, tuple):
+            lane, idx = stored._i
+        else:
+            lane, idx = stored._i, None
+        key = (id(stored._stacked), idx is None)
+        g = by.get(key)
+        if g is None:
+            g = by[key] = (stored._stacked, [], [], [])
+            order.append(key)
+        g[1].append(lane)
+        g[2].append(idx)
+        g[3].append(tgt)
+    groups = []
+    for key in order:
+        buf, lanes, idxs, tgts = by[key]
+        groups.append((
+            buf,
+            np.asarray(lanes, np.int32),
+            None if key[1] else np.asarray(idxs, np.int32),
+            np.asarray(tgts, np.int32),
+        ))
+    return groups, fallback
+
+
+_fused_load_jits: dict = {}
+
+
+def _gather_group_rows(buf, lanes, idxs):
+    import jax
+
+    if idxs is None:
+        return jax.tree.map(lambda a: a[lanes], buf)
+    return jax.tree.map(lambda a: a[lanes, idxs], buf)
+
+
+def fused_load_rows(worlds, groups, transform=None):
+    """ONE jitted dispatch: gather rows out of several stacked source
+    buffers and scatter them into the resident ``[M, ...]`` worlds at
+    ``targets`` — the mixed-source batched load.
+
+    ``groups`` comes from :func:`plan_row_gather`.  ``transform`` (optional)
+    is vmapped over the gathered rows before the scatter — the non-identity
+    snapshot strategies' ``load_state`` hook, fused into the same program.
+    The jitted body is cached per ``transform`` object (hold a stable
+    reference!) and re-traced by jax per group structure/shape, so
+    steady-state wave shapes hit the cache."""
+    import jax
+
+    fn = _fused_load_jits.get(transform)
+    if fn is None:
+
+        def body(worlds, groups):
+            for buf, lanes, idxs, targets in groups:
+                rows = _gather_group_rows(buf, lanes, idxs)
+                if transform is not None:
+                    rows = jax.vmap(transform)(rows)
+                worlds = jax.tree.map(
+                    lambda w, r: w.at[targets].set(r), worlds, rows
+                )
+            return worlds
+
+        fn = _fused_load_jits[transform] = jax.jit(body)
+    return fn(worlds, tuple(groups))
+
+
+_fused_gather_jits: dict = {}
+
+
+def fused_gather_rows(groups, transform=None):
+    """ONE jitted dispatch: gather rows from several stacked buffers into a
+    fresh ``[n, ...]`` stack (group-concatenation order), optionally mapping
+    ``transform`` over the rows (vmapped).
+
+    The BatchedRunner's non-identity save path uses this to run
+    ``store_state`` over every saved row of a wave in one dispatch instead
+    of a per-lobby materialize loop; row ``j`` of the result backs a
+    ``LazySlice(result, j)`` ring entry.  Output row order follows the
+    groups' target arrays concatenated in order — callers map their logical
+    indices through that permutation host-side (no device permute)."""
+    import jax
+
+    fn = _fused_gather_jits.get(transform)
+    if fn is None:
+
+        def body(groups):
+            parts = [
+                _gather_group_rows(buf, lanes, idxs)
+                for buf, lanes, idxs, _t in groups
+            ]
+            if len(parts) == 1:
+                rows = parts[0]
+            else:
+                import jax.numpy as jnp
+
+                rows = jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *parts
+                )
+            if transform is not None:
+                rows = jax.vmap(transform)(rows)
+            return rows
+
+        fn = _fused_gather_jits[transform] = jax.jit(body)
+    return fn(tuple(groups))
+
+
 def tree_index2(stacked, b: int, i: int):
     """``tree.map(a[b, i])`` as ONE jitted dispatch (doubly-stacked
     ``[lobby, frame, ...]`` buffers; see :func:`tree_index`)."""

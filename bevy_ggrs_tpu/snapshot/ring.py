@@ -23,7 +23,7 @@ Unit-test parity: tests/test_ring.py ports the battery at mod.rs:369-512.
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Generic, Optional, TypeVar
+from typing import Deque, Generic, List, Optional, Sequence, Tuple, TypeVar
 
 from ..utils.frames import frame_ge, frame_lt
 
@@ -113,3 +113,17 @@ class SnapshotRing(Generic[T]):
         """Drop every stored snapshot."""
         self._frames.clear()
         self._snapshots.clear()
+
+
+def rollback_many(
+    rings: Sequence["SnapshotRing[T]"], targets: Sequence[Tuple[int, int]]
+) -> List[Tuple[int, T]]:
+    """Batched rollback across a server's per-lobby rings.
+
+    ``targets`` is ``[(ring_index, frame), ...]``; each named ring performs
+    its normal :meth:`SnapshotRing.rollback` (discarding newer entries,
+    raising :class:`MissingSnapshotError` on absence) and the stored
+    snapshots come back as ``[(ring_index, snapshot), ...]`` in target order
+    — the input :func:`..snapshot.lazy.plan_row_gather` groups into one
+    fused device gather for the BatchedRunner's mixed-source load wave."""
+    return [(i, rings[i].rollback(f)) for i, f in targets]

@@ -31,6 +31,7 @@ from .forensics import (  # noqa: F401 (public re-exports)
 from .metrics import (  # noqa: F401
     FRAME_BUCKETS,
     MS_BUCKETS,
+    BoundMetric,
     Counter,
     Gauge,
     Histogram,
@@ -47,6 +48,7 @@ from .timeline import (  # noqa: F401
 )
 
 __all__ = [
+    "BoundMetric",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsExporter",
     "Timeline", "FRAME_BUCKETS", "MS_BUCKETS",
     "enable", "disable", "enabled", "reset", "summary",

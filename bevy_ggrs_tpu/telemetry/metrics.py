@@ -143,6 +143,9 @@ class MetricsRegistry:
         self.enabled = enabled
         self._metrics: Dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        # bumped on every reset() so BoundMetric handles held by hot loops
+        # know their cached family object is stale
+        self.generation = 0
 
     def set_enabled(self, enabled: bool) -> None:
         """Enable/disable all mutation on this registry's metrics."""
@@ -205,9 +208,28 @@ class MetricsRegistry:
         return out
 
     def reset(self) -> None:
-        """Drop every metric family (test isolation)."""
+        """Drop every metric family (test isolation).
+
+        Bumps :attr:`generation` so :class:`BoundMetric` handles held by hot
+        loops re-resolve their family on the next call instead of mutating an
+        orphaned object."""
         with self._lock:
             self._metrics.clear()
+            self.generation += 1
+
+    def bind_counter(self, name: str, help: str = "") -> "BoundMetric":
+        """Pre-bound counter handle for hot loops (see :class:`BoundMetric`)."""
+        return BoundMetric(self, "counter", name, help)
+
+    def bind_gauge(self, name: str, help: str = "") -> "BoundMetric":
+        """Pre-bound gauge handle for hot loops."""
+        return BoundMetric(self, "gauge", name, help)
+
+    def bind_histogram(
+        self, name: str, help: str = "", buckets: Sequence[float] = FRAME_BUCKETS
+    ) -> "BoundMetric":
+        """Pre-bound histogram handle for hot loops."""
+        return BoundMetric(self, "histogram", name, help, buckets=buckets)
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition format (version 0.0.4) of everything."""
@@ -244,6 +266,71 @@ def _fmt_float(v) -> str:
 def _fmt_labels(key: LabelKey, **extra) -> str:
     parts = [f'{k}="{v}"' for k, v in key] + [f'{k}="{v}"' for k, v in extra.items()]
     return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class BoundMetric:
+    """Resolve-once handle to a metric family for per-tick hot paths.
+
+    The ad-hoc ``telemetry.count(name, n, help=...)`` convenience re-passes the
+    name and help string on every call, which in the driver loop means a dict
+    lookup plus string traffic per tick per metric.  A ``BoundMetric`` does the
+    name/help registration exactly once (at construction) and afterwards its
+    :meth:`inc`/:meth:`set`/:meth:`observe` are a couple of attribute checks
+    plus the underlying metric mutation.  The handle watches the registry's
+    ``generation`` counter so a ``reset()`` (test isolation) transparently
+    re-creates the family rather than mutating an orphan that no snapshot
+    will ever see.
+    """
+
+    __slots__ = ("_reg", "_kind", "_name", "_help", "_kw", "_gen", "_m")
+
+    def __init__(self, reg: MetricsRegistry, kind: str, name: str, help: str, **kw):
+        self._reg = reg
+        self._kind = kind
+        self._name = name
+        self._help = help
+        self._kw = kw
+        self._gen = -1
+        self._m: Optional[_Metric] = None
+        self._resolve()
+
+    def _resolve(self) -> _Metric:
+        if self._kind == "counter":
+            self._m = self._reg.counter(self._name, self._help)
+        elif self._kind == "gauge":
+            self._m = self._reg.gauge(self._name, self._help)
+        else:
+            self._m = self._reg.histogram(self._name, self._help, **self._kw)
+        self._gen = self._reg.generation
+        return self._m
+
+    def _metric(self) -> _Metric:
+        m = self._m
+        if self._gen != self._reg.generation:
+            m = self._resolve()
+        return m
+
+    def inc(self, n: float = 1) -> None:
+        """Counter/gauge increment by ``n`` (no labels — that's the point)."""
+        if not self._reg.enabled:
+            return
+        self._metric().inc(n)
+
+    def set(self, v: float) -> None:
+        """Gauge set."""
+        if not self._reg.enabled:
+            return
+        self._metric().set(v)
+
+    def observe(self, v: float) -> None:
+        """Histogram observation."""
+        if not self._reg.enabled:
+            return
+        self._metric().observe(v)
+
+    def value(self) -> float:
+        """Current unlabeled value (0 if the family was reset away)."""
+        return self._metric().value()
 
 
 _REGISTRY = MetricsRegistry()

@@ -29,6 +29,9 @@ g.dryrun_multichip(8)
 print("graft ok")
 EOF
 
+echo "== bench smoke (batched stage, O(1)-dispatch gate) =="
+python bench.py --smoke
+
 echo "== bench =="
 python bench.py
 
