@@ -69,6 +69,7 @@ from .snapshot.lazy import (
     fused_load_rows,
     materialize,
     plan_row_gather,
+    readback_queue,
 )
 from .snapshot.ring import SnapshotRing, rollback_many
 from .utils.frames import NULL_FRAME, frame_add
@@ -113,6 +114,7 @@ class BatchedRunner:
         on_mismatch: Optional[Callable[[int, MismatchedChecksumError], None]] = None,
         on_event: Optional[Callable[[int, object], None]] = None,
         k_max: Optional[int] = None,
+        pipeline: bool = True,
     ):
         if app.canonical_depth is not None or app.canonical_branches is not None:
             raise ValueError(
@@ -161,7 +163,14 @@ class BatchedRunner:
         self._batch_checksum_fn = _jax.jit(
             lambda ws: _jax.vmap(lambda w: _wc(app.reg, w))(ws)
         )
+        # pipelined readback: start non-blocking device->host checksum
+        # copies at dispatch time and collect them at the next tick() —
+        # same engine as GgrsRunner (docs/architecture.md "Tick pipeline")
+        self.pipeline = bool(pipeline)
+        self._rbq = readback_queue()
         init_batch = BatchChecks(self._batch_checksum_fn(self.worlds))
+        if self.pipeline:
+            self._rbq.start(init_batch)
         self._world_checksum = [init_batch.ref(b) for b in range(m)]
         self.rings = [SnapshotRing(depth=max(windows) + 2) for _ in range(m)]
         self.frames = [0] * m  # per-lobby RollbackFrameCount
@@ -228,6 +237,10 @@ class BatchedRunner:
         """One server tick: poll + step every lobby, flush as waves."""
         self.ticks += 1
         self._m_ticks.inc()
+        if self.pipeline:
+            # harvest last tick's landed checksum copies before the lobby
+            # polls publish them (never blocks)
+            self._rbq.harvest()
         per_lobby_ops: List[List[_Op]] = []
         for b, s in enumerate(self.sessions):
             per_lobby_ops.append(self._collect_ops(b, s))
@@ -406,6 +419,8 @@ class BatchedRunner:
                     self.worlds, inputs, status, starts, ks
                 )
                 batch = BatchChecks(checks_flat)
+                if self.pipeline:
+                    self._rbq.start(batch)
                 self.worlds = finals
                 for b in range(m):
                     if ks[b] > 0:
@@ -459,7 +474,9 @@ class BatchedRunner:
                     else batch.ref(b * bucket + (c - 1))
                 )
                 self.rings[b].push(r.frame, (stored, cs))
-                r.cell.save(r.frame, cs.to_int)
+                # the ref itself is the provider (callable, with a
+                # non-blocking peek() for the pipelined consume path)
+                r.cell.save(r.frame, cs)
 
     # -- observability ------------------------------------------------------
 
@@ -500,14 +517,17 @@ class BatchedRunner:
         return _row(self.worlds, b)
 
     def lobby_checksum(self, b: int) -> int:
-        """Lobby ``b``'s live 64-bit world checksum (forces the fused
-        batched pull — see snapshot/lazy.py)."""
+        """Lobby ``b``'s live 64-bit world checksum (an allowlisted flush
+        point: forces the fused batched pull — see snapshot/lazy.py —
+        though a landed async copy makes it free)."""
         from .snapshot.checksum import checksum_to_int
 
+        self._rbq.harvest()
         return checksum_to_int(self._world_checksum[b])
 
     def finish(self) -> None:
         """Flush deferred checksum comparisons on every lobby session."""
+        self._rbq.harvest()
         for b, s in enumerate(self.sessions):
             if hasattr(s, "check_now"):
                 try:

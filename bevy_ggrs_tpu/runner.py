@@ -32,7 +32,13 @@ from .session.events import (
 from .session.requests import AdvanceRequest, GgrsRequest, LoadRequest, SaveRequest
 from .session.synctest import SyncTestSession
 from .snapshot.checksum import checksum_to_int
-from .snapshot.lazy import BatchChecks, LazySlice, materialize, wrap_single_checksum
+from .snapshot.lazy import (
+    BatchChecks,
+    LazySlice,
+    materialize,
+    readback_queue,
+    wrap_single_checksum,
+)
 from .snapshot.ring import SnapshotRing
 from .ops.resim import slice_frame
 from .ops.speculation import SpeculationCache, SpeculationConfig
@@ -54,6 +60,7 @@ class GgrsRunner:
         on_advance: Optional[Callable] = None,
         on_confirmed: Optional[Callable[[int], None]] = None,
         coalesce_frames: int = 1,
+        pipeline: bool = True,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
@@ -134,6 +141,33 @@ class GgrsRunner:
         self._last_stacked = None  # previous dispatch's stacked saves
         self._last_k = 0
         self._last_stacked_frame: Optional[int] = None
+        # Tick pipelining (docs/architecture.md "Tick pipeline"): dispatch
+        # frame N's fused program, start its checksum readback as a
+        # NON-blocking async copy, and do tick N+1's host work (network
+        # poll, input collection, ring bookkeeping on lazy refs) before
+        # anything touches N's outputs.  Landed copies are harvested at the
+        # top of each update(); the in-flight window is one dispatch deep
+        # (the next dispatch's XLA data dependency on `final` serializes
+        # naturally).  pipeline=False restores the pre-pipeline synchronous
+        # shape (no async starts, no harvest) — the bench's sync baseline.
+        self.pipeline = bool(pipeline)
+        self._rbq = readback_queue()
+        self.pipeline_degrades = 0  # loads that targeted in-flight output
+        # Persistent solo-runner staging (the BatchedRunner's pinned-buffer
+        # pattern): steady-state ticks fill these in place instead of
+        # allocating a fresh np.stack per dispatch.  Sized lazily from the
+        # first dispatch, grown geometrically when a deeper run appears;
+        # jit sees the same [k, ...] shapes np.stack produced (views of the
+        # capacity buffer), so no new trace variants.  Safe to reuse across
+        # dispatches: jax copies numpy arguments to device buffers at call
+        # time (the BatchedRunner has shipped this shape since PR 2).
+        self._stage_inputs: Optional[np.ndarray] = None
+        self._stage_status: Optional[np.ndarray] = None
+        self._stage_cap = 0
+        # stacked-save device bytes depend only on the dispatch depth k
+        # (shapes are static per app), so compute once per depth instead of
+        # walking the pytree every tick
+        self._stacked_bytes_by_k: dict = {}
         if session is not None:
             self.set_session(session)
 
@@ -250,6 +284,9 @@ class GgrsRunner:
         s = self.session
         if s is None or not hasattr(s, "check_now"):
             return
+        # free harvest first: copies that already landed won't count as
+        # forced readbacks in the flush below
+        self._rbq.harvest()
         try:
             s.check_now()
         except MismatchedChecksumError as e:
@@ -275,6 +312,11 @@ class GgrsRunner:
         if self.session is None:
             self.accumulator = 0.0
             return
+        if self.pipeline:
+            # collect last tick's landed checksum copies BEFORE the network
+            # poll, so the session's desync driver publishes them this tick
+            # without ever blocking on the device
+            self._rbq.harvest()
         if hasattr(self.session, "poll_remote_clients"):
             with span("PollRemoteClients"):
                 self.session.poll_remote_clients()
@@ -283,6 +325,7 @@ class GgrsRunner:
                 self._record_network_stats()
         pending: List[GgrsRequest] = []
         pending_ticks = 0
+        ran_requests = False
         while self.accumulator >= fps_delta:
             self.accumulator -= fps_delta
             if hasattr(self.session, "frames_ahead"):
@@ -295,24 +338,52 @@ class GgrsRunner:
                     self._handle_requests(pending)
                     pending = []
                     pending_ticks = 0
+                    ran_requests = True
             fps_delta = (1.0 / self.app.fps) * (1.1 if self.run_slow else 1.0)
         if pending:
             self._handle_requests(pending)
+            ran_requests = True
+        if ran_requests and not self.pipeline:
+            # synchronous mode: zero-deep in-flight window — retire this
+            # tick's device work (world + checksum readback) before the
+            # driver returns, exactly the behavior pipelining replaces
+            self._drain_inflight()
 
     @property
     def checksum(self) -> int:
         """Current world checksum as the 64-bit cross-peer value (the
         user-readable ``Checksum`` resource analog, checksum.rs:48-56).
-        Forces a device sync."""
+        Forces a device sync (an allowlisted flush point — free when the
+        async copy already landed)."""
+        if self.pipeline:
+            self._rbq.harvest()
         return checksum_to_int(self._world_checksum)
+
+    def _drain_inflight(self) -> None:
+        """Flush the in-flight window: collect landed async readbacks and
+        block until the live world's dispatch completes, so external reads
+        observe the post-dispatch state (allowlisted in the hot-loop purity
+        lint — this IS the blocking point)."""
+        import jax
+
+        if self.pipeline:
+            self._rbq.harvest()
+        else:
+            # synchronous mode: retire checksum readbacks with the tick —
+            # these count as forced (blocking) pulls in the readback stats
+            BatchChecks.pull_pending()
+        jax.block_until_ready(self._world.comps)
 
     def read_components(self, names=None) -> dict:
         """Fetch component columns (and the active mask) to host numpy in one
-        transfer — the render-readback path.  ``names=None`` fetches all."""
+        transfer — the render-readback path.  ``names=None`` fetches all.
+        Drains the in-flight dispatch window first so a mid-pipeline read
+        can't observe a stale world."""
         import jax
 
         from .snapshot.world import active_mask
 
+        self._drain_inflight()
         names = list(names) if names is not None else list(self.app.reg.components)
         arrays = {n: self.world.comps[n] for n in names}
         for n in names:
@@ -351,6 +422,8 @@ class GgrsRunner:
             "speculation_cached_bytes": getattr(self.spec_cache, "cached_bytes", 0),
             "frame": self.frame,
             "confirmed": self.confirmed,
+            "pipeline": self.pipeline,
+            "pipeline_degrades": self.pipeline_degrades,
         }
 
     def tick(self) -> None:
@@ -556,6 +629,23 @@ class GgrsRunner:
         with span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
             was_lazy = isinstance(stored, LazySlice)
+            if (
+                self.pipeline
+                and was_lazy
+                and self._last_stacked is not None
+                and stored._stacked is self._last_stacked
+            ):
+                # the Load targets the most recent dispatch's stacked output:
+                # the materialize below carries an XLA data dependency on that
+                # dispatch, so the one-deep window degrades to the synchronous
+                # shape for this tick (correct by construction; counted so the
+                # degradation rate is observable)
+                self.pipeline_degrades += 1
+                telemetry.count(
+                    "pipeline_degrade_total",
+                    help="loads targeting the in-flight dispatch's output "
+                         "(pipeline degraded to synchronous for that tick)",
+                )
             self.world = self.app.reg.load_state(materialize(stored))
             self._world_checksum = checksum
             self.frame = frame
@@ -570,6 +660,35 @@ class GgrsRunner:
             # branches hedged from now-superseded predicted states must not
             # serve future lookups (see SpeculationCache.invalidate_after)
             self.spec_cache.invalidate_after(frame)
+
+    def _stage_rows(self, adv: List[AdvanceRequest]):
+        """Fill the persistent pinned input/status buffers in place and
+        return ``[k, ...]`` views — the BatchedRunner staging pattern ported
+        to the solo runner, so steady-state ticks allocate nothing on host.
+        Views of the capacity buffer have exactly the shapes ``np.stack``
+        produced, so the jit cache sees no new variants."""
+        k = len(adv)
+        row_in = np.asarray(adv[0].inputs)
+        row_st = np.asarray(adv[0].status)
+        if (
+            self._stage_inputs is None
+            or self._stage_cap < k
+            or self._stage_inputs.shape[1:] != row_in.shape
+            or self._stage_inputs.dtype != row_in.dtype
+            or self._stage_status.shape[1:] != row_st.shape
+            or self._stage_status.dtype != row_st.dtype
+        ):
+            self._stage_cap = max(k, self._stage_cap * 2)
+            self._stage_inputs = np.zeros(
+                (self._stage_cap, *row_in.shape), row_in.dtype
+            )
+            self._stage_status = np.zeros(
+                (self._stage_cap, *row_st.shape), row_st.dtype
+            )
+        for i, a in enumerate(adv):
+            self._stage_inputs[i] = a.inputs
+            self._stage_status[i] = a.status
+        return self._stage_inputs[:k], self._stage_status[:k]
 
     def _run_batch(self, run: List[GgrsRequest]) -> None:
         """Execute a maximal Advance/Save run as one fused device call.
@@ -664,8 +783,7 @@ class GgrsRunner:
                     "donated_dispatches_total", help="dispatches donating the input world"
                 )
             with span("AdvanceWorld"):
-                inputs = np.stack([a.inputs for a in adv[skip:]])
-                status = np.stack([a.status for a in adv[skip:]])
+                inputs, status = self._stage_rows(adv[skip:])
                 if use_branched:
                     final, stacked, checks = self._dispatch_branched(
                         inputs, status, adv[-1]
@@ -681,6 +799,11 @@ class GgrsRunner:
                         self.world, inputs, status, self.frame
                     )
                 batch_checks = BatchChecks(checks)
+                if self.pipeline:
+                    # ahead-of-tick readback: the device->host checksum copy
+                    # rides behind the dispatch; harvest() collects it next
+                    # tick while the device runs frame N+1
+                    self._rbq.start(batch_checks)
                 if self.spec_cache is not None and k - skip >= 2:
                     last_adv_src = slice_frame(stacked, k - skip - 2)
                 self.world = final
@@ -692,9 +815,12 @@ class GgrsRunner:
                 self._world_donatable = True  # final is a fresh buffer
         materialize_saves = False
         if stacked is not None:
-            from .utils.mem import tree_device_bytes
+            stacked_bytes = self._stacked_bytes_by_k.get(k - skip)
+            if stacked_bytes is None:
+                from .utils.mem import tree_device_bytes
 
-            stacked_bytes = tree_device_bytes(stacked)
+                stacked_bytes = tree_device_bytes(stacked)
+                self._stacked_bytes_by_k[k - skip] = stacked_bytes
             materialize_saves = stacked_bytes > self.ring_materialize_bytes
             telemetry.gauge_set(
                 "save_bytes", stacked_bytes,
@@ -717,7 +843,9 @@ class GgrsRunner:
                         # may already be dead — serve from the previous
                         # dispatch's stacked saves / the pre-encoded store
                         self.ring.push(r.frame, (c0_stored, pre_checksum))
-                        r.cell.save(r.frame, pre_checksum.to_int)
+                        # the ref itself is the provider: callable (forcing)
+                        # with a non-blocking peek() for the pipelined path
+                        r.cell.save(r.frame, pre_checksum)
                         continue
                     state_s, cs = pre_world, pre_checksum
                     pushed_pre_world = identity
@@ -737,7 +865,7 @@ class GgrsRunner:
                     else self.app.reg.store_state(materialize(state_s))
                 )
                 self.ring.push(r.frame, (stored, cs))
-                r.cell.save(r.frame, cs.to_int)
+                r.cell.save(r.frame, cs)
         if pushed_pre_world and self._world is pre_world:
             # save-only run (or full cache skip): the ring now aliases the
             # live world object; the next dispatch must not donate it

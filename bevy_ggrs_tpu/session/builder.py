@@ -30,6 +30,7 @@ class SessionBuilder:
         self._disconnect_notify_start_s = 0.5
         self._catchup_speed = 1
         self._input_predictor = None
+        self._eager_checksums = False
 
     @classmethod
     def for_app(cls, app) -> "SessionBuilder":
@@ -70,6 +71,14 @@ class SessionBuilder:
         SURVEY §2.3); default PredictRepeatLast.  ``predictor(queue, frame)``
         returns the guessed input value."""
         self._input_predictor = predictor
+        return self
+
+    def with_eager_checksums(self, eager: bool = True) -> "SessionBuilder":
+        """Force desync-detection checksum providers at the tick their frame
+        confirms (the pre-pipeline synchronous behavior — the bench's sync
+        baseline).  Default off: providers are peeked non-blocking and
+        published when the async device->host copy lands."""
+        self._eager_checksums = eager
         return self
 
     def with_disconnect_timeout(self, seconds: float) -> "SessionBuilder":
@@ -121,6 +130,7 @@ class SessionBuilder:
             disconnect_timeout_s=self._disconnect_timeout_s,
             disconnect_notify_start_s=self._disconnect_notify_start_s,
             input_predictor=self._input_predictor,
+            eager_checksums=self._eager_checksums,
         )
 
     def start_p2p_session_native(self, local_port: int = 0):

@@ -324,7 +324,19 @@ class NativeP2PSession:
         for frame in sorted(self._pending_checksums):
             if frame > confirmed:
                 break
-            value = self._pending_checksums.pop(frame)()
+            provider = self._pending_checksums[frame]
+            peek = getattr(provider, "peek", None)
+            value = peek() if peek is not None else None
+            if peek is not None and value is None:
+                if frame > confirmed - self._max_prediction:
+                    # async copy still in flight and the frame is well inside
+                    # the window — the native core accepts late checksums, so
+                    # retry next poll instead of blocking the tick
+                    continue
+                value = provider()  # leaving the window: force (flush)
+            elif peek is None:
+                value = provider()
+            del self._pending_checksums[frame]
             if value is not None:
                 self._lib.ggrs_p2p_push_checksum(self._s, frame, value & (2**64 - 1))
 

@@ -91,6 +91,7 @@ class P2PSession:
         disconnect_timeout_s: float = 2.0,
         disconnect_notify_start_s: float = 0.5,
         input_predictor=None,
+        eager_checksums: bool = False,
     ):
         self._num_players = num_players
         self.socket = socket
@@ -100,6 +101,13 @@ class P2PSession:
         self._max_prediction = max_prediction
         self.input_delay = input_delay
         self.desync_detection = desync_detection
+        # eager_checksums=True forces every local checksum provider at the
+        # tick its frame confirms (the pre-pipeline synchronous behavior;
+        # the bench's sync baseline).  Default off: providers are peeked
+        # non-blocking each poll and published once the async device->host
+        # copy lands — the protocol already tolerates checksums arriving
+        # k frames late (docs/architecture.md "Tick pipeline").
+        self.eager_checksums = bool(eager_checksums)
         self.current_frame = 0
         self._confirmed = NULL_FRAME
         self.events_buf: List = []
@@ -511,7 +519,23 @@ class P2PSession:
         if len(self._local_sent) > MAX_UNACKED_FRAMES:
             self._local_sent = self._local_sent[-MAX_UNACKED_FRAMES:]
         for fr in [f for f in self._local_checksums if frame_lt(f, horizon)]:
-            del self._local_checksums[fr]
+            entry = self._local_checksums.pop(fr)
+            if (
+                callable(entry)
+                and self.desync_detection.enabled
+                and fr % self.desync_detection.interval == 0
+                and frame_le(fr, self._confirmed)
+            ):
+                # backstop: an interval frame leaving the window whose async
+                # copy never landed — force it now (ONE blocking readback,
+                # counted as forced) rather than silently dropping the
+                # comparison.  Steady state never reaches this: harvest()
+                # lands copies within a tick or two while the horizon trails
+                # confirmed by max_prediction + 2 frames.
+                v = self._resolve_checksum(entry, True)
+                if v is not None:
+                    self._publish_checksum(fr, v)
+                    self._compare_checksum(fr, v)
         for key in [k for k in self._remote_checksums if frame_lt(k[1], horizon)]:
             del self._remote_checksums[key]
 
@@ -565,37 +589,76 @@ class P2PSession:
         if self.desync_detection.enabled:
             self._local_checksums[frame] = provider
 
-    def _drive_desync_detection(self) -> None:
+    def check_now(self) -> None:
+        """Flush point: force every deferred local checksum provider and
+        publish/compare immediately (``Runner.finish()`` / ``set_session``
+        reach this through the same ``check_now`` hook SyncTest uses).  The
+        steady-state path never forces — see :meth:`_drive_desync_detection`."""
+        self._drive_desync_detection(force=True)
+
+    @staticmethod
+    def _resolve_checksum(provider, force: bool):
+        """Provider -> masked 64-bit value, or None when not yet available.
+
+        The non-forcing path uses the provider's ``peek()`` (non-blocking;
+        starts the device->host copy and returns None until it lands — the
+        driver simply retries next poll, riding the protocol's existing
+        tolerance for late checksums).  Forcing blocks on the device and is
+        reserved for flush points, the GC backstop, and eager/sync mode
+        (allowlisted in the hot-loop purity lint)."""
+        if not force:
+            peek = getattr(provider, "peek", None)
+            if peek is not None:
+                v = peek()
+            else:
+                v = provider()  # host-side provider: no device to wait on
+        else:
+            v = provider()
+        return None if v is None else v & (2**64 - 1)
+
+    def _publish_checksum(self, frame: int, value: int) -> None:
+        for ep in self.endpoints.values():
+            if not ep.disconnected and ep.state == SessionState.RUNNING:
+                ep.send_checksum(frame, value)
+
+    def _compare_checksum(self, frame: int, local: int) -> None:
+        """Compare a resolved local checksum against any received reports."""
+        for (addr, f), remote in list(self._remote_checksums.items()):
+            if f == frame:
+                if remote != local:
+                    telemetry.count(
+                        "checksum_mismatch_total",
+                        help="frames whose checksums disagreed", kind="p2p",
+                    )
+                    self.events_buf.append(
+                        DesyncDetected(
+                            frame=f,
+                            local_checksum=local,
+                            remote_checksum=remote,
+                            addr=addr,
+                        )
+                    )
+                del self._remote_checksums[(addr, f)]
+
+    def _drive_desync_detection(self, force: bool = False) -> None:
         if not self.desync_detection.enabled:
             return
         interval = self.desync_detection.interval
+        remote_frames = {f for (_, f) in self._remote_checksums}
         for frame in sorted(self._local_checksums):
             if frame % interval != 0 or not frame_le(frame, self._confirmed):
                 continue
             entry = self._local_checksums[frame]
             if callable(entry):
-                entry = entry()
+                entry = self._resolve_checksum(
+                    entry, force or self.eager_checksums
+                )
                 if entry is None:
-                    continue
-                entry &= 2**64 - 1
+                    continue  # copy in flight — retry next poll
                 self._local_checksums[frame] = entry
-                for ep in self.endpoints.values():
-                    if not ep.disconnected and ep.state == SessionState.RUNNING:
-                        ep.send_checksum(frame, entry)
-            # compare against any received reports
-            for (addr, f), remote in list(self._remote_checksums.items()):
-                if f == frame:
-                    if remote != entry:
-                        telemetry.count(
-                            "checksum_mismatch_total",
-                            help="frames whose checksums disagreed", kind="p2p",
-                        )
-                        self.events_buf.append(
-                            DesyncDetected(
-                                frame=f,
-                                local_checksum=entry,
-                                remote_checksum=remote,
-                                addr=addr,
-                            )
-                        )
-                    del self._remote_checksums[(addr, f)]
+                self._publish_checksum(frame, entry)
+            # a resolved local sticks around until the remote report shows
+            # up (or GC) — only walk the comparison dict when it has a
+            # matching frame, not on every poll
+            if frame in remote_frames:
+                self._compare_checksum(frame, entry)

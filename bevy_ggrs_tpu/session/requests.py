@@ -6,7 +6,10 @@ save cell carries only the *checksum* — real state lives in the driver's
 snapshot ring, not in the session (schedule_systems.rs:236: the plugin calls
 ``cell.save(frame, None, checksum)``).  The checksum is passed as a lazy
 provider so a device->host sync only happens when the protocol actually needs
-the value (SyncTest comparison, desync-detection interval frames)."""
+the value (SyncTest comparison, desync-detection interval frames).  Drivers
+pass a :class:`~bevy_ggrs_tpu.snapshot.lazy.ChecksumRef` directly: it is
+callable (forcing) and additionally offers a non-blocking ``peek()`` that the
+pipelined consume paths poll until the async device->host copy lands."""
 
 from __future__ import annotations
 
@@ -24,7 +27,10 @@ class SaveCell:
         self.frame = frame
 
     def save(self, frame: int, checksum_provider: Optional[Callable[[], int]]):
-        """Record the checksum provider for this frame (state stays driver-side)."""
+        """Record the checksum provider for this frame (state stays
+        driver-side).  The provider is any callable returning the 64-bit
+        value (or None); providers with a ``peek()`` method are consumed
+        non-blocking by the pipelined sessions."""
         self._session._on_cell_saved(frame, checksum_provider)
 
 

@@ -44,6 +44,7 @@ _RELAY = 4     # c->s: dst_peer_id + payload
 _FWD = 5       # s->c: src_peer_id + payload
 _PING = 6      # c->s keepalive (also re-requests the roster)
 _LEAVE = 7     # c->s: explicit departure
+_REJECT = 8    # s->c: room, reason (join refused — e.g. bad join token)
 
 PING_INTERVAL_S = 0.5
 MEMBER_TIMEOUT_S = 5.0
@@ -111,11 +112,19 @@ class RoomServer:
     from a game loop, a thread, or the ``scripts/room_server.py`` CLI."""
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
-                 member_timeout_s: float = MEMBER_TIMEOUT_S):
+                 member_timeout_s: float = MEMBER_TIMEOUT_S,
+                 join_token: Optional[str] = None):
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
         self._sock.setblocking(False)
         self._sock.bind((host, port))
         self.member_timeout_s = member_timeout_s
+        # optional shared-secret admission control (off by default): when
+        # set, a JOIN must carry the same token or it is rejected with a
+        # reason.  This closes the "any addr can join/kick/impersonate a
+        # peer id" hole for deployments that can distribute a secret; it
+        # is NOT transport encryption — see docs/architecture.md
+        # "Trust model (networking)".
+        self.join_token = join_token
         # room -> peer_id -> (addr, last_seen)
         self.rooms: Dict[str, Dict[str, Tuple[Any, float]]] = {}
         self._addr_index: Dict[Any, Tuple[str, str]] = {}  # addr -> (room, peer)
@@ -143,10 +152,20 @@ class RoomServer:
         r = _Reader(data[_HDR.size:])
         now = time.monotonic()
         if t == _JOIN:
-            # membership is claimed, not authenticated (trusted-network
-            # model — docs/architecture.md "Trust model (networking)")
+            # membership is claimed, not authenticated unless a join token
+            # is configured (trusted-network model — docs/architecture.md
+            # "Trust model (networking)")
             room, peer = r.s(), r.s()
             if not r.ok or not room or not peer:
+                return
+            # optional trailing token field: absent in pre-token clients
+            # (old servers likewise ignore the trailing bytes, so a
+            # token-carrying client stays compatible with them)
+            token = r.s() if r.i < len(r.b) else ""
+            if self.join_token is not None and token != self.join_token:
+                out = (_HDR.pack(ROOM_MAGIC, _REJECT) + _pack_str(room)
+                       + _pack_str("bad join token"))
+                self._send(out, addr)
                 return
             # destination capacity FIRST: a rejected move must leave the
             # old membership intact (dropping it before the check would
@@ -277,7 +296,8 @@ class RoomSocket:
 
     def __init__(self, server_addr: Tuple[str, int], room: str,
                  peer_id: Optional[str] = None, mode: str = "direct",
-                 port: int = 0, host: str = "0.0.0.0"):
+                 port: int = 0, host: str = "0.0.0.0",
+                 join_token: Optional[str] = None):
         if mode not in ("direct", "relay"):
             raise ValueError("mode must be 'direct' or 'relay'")
         # resolve once: inbound packets are validated against the source
@@ -292,6 +312,8 @@ class RoomSocket:
         self._sock.setblocking(False)
         self._sock.bind((host, port))
         self.roster: Dict[str, Tuple[str, int]] = {}  # peer_id -> addr
+        self.join_token = join_token
+        self.last_reject: Optional[str] = None  # server's refusal reason
         self._last_ping = 0.0
         self._last_roster = time.monotonic()
         self._join()
@@ -303,6 +325,9 @@ class RoomSocket:
     def _join(self) -> None:
         pkt = (_HDR.pack(ROOM_MAGIC, _JOIN)
                + _pack_str(self.room) + _pack_str(self.peer_id))
+        if self.join_token is not None:
+            # trailing field: old servers ignore it (backward compatible)
+            pkt += _pack_str(self.join_token)
         self._raw_send(pkt, self.server_addr)
 
     def players(self) -> List[str]:
@@ -374,6 +399,13 @@ class RoomSocket:
             self.roster = roster
             self._last_roster = time.monotonic()
             return None
+        if t == _REJECT:
+            if addr != self.server_addr:
+                return None  # rejections are authoritative: server-origin only
+            room, reason = r.s(), r.s()
+            if r.ok and room == self.room:
+                self.last_reject = reason or "join rejected"
+            return None
         if t == _FWD:
             if addr != self.server_addr:
                 return None  # relayed data comes only from the server
@@ -421,6 +453,12 @@ def wait_for_players(sock: RoomSocket, n: int, timeout_s: float = 10.0,
         if server is not None:
             server.poll()
         players = sock.poll_roster()
+        if sock.last_reject is not None:
+            # the server refused the join (e.g. bad join token): fail fast
+            # with the reason instead of spinning until the timeout
+            raise PermissionError(
+                f"room '{sock.room}' join rejected: {sock.last_reject}"
+            )
         if len(players) >= n:
             return players
         time.sleep(0.005)

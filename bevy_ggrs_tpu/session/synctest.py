@@ -19,6 +19,7 @@ from typing import Dict, List
 import numpy as np
 
 from .. import telemetry
+from ..snapshot.lazy import readback_queue
 from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import InputStatus, InvalidRequestError, MismatchedChecksumError
 from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
@@ -197,6 +198,10 @@ class SyncTestSession:
         self._cells.setdefault(frame, []).append(provider)
 
     def _check_mismatches(self) -> None:
+        # collect any landed async checksum copies first: with the pipelined
+        # runner, most providers forced below resolve from the harvested
+        # cache instead of blocking on the device
+        readback_queue().harvest()
         mismatched = []
         for frame, entries in self._cells.items():
             if len(entries) < 2:

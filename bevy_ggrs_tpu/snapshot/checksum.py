@@ -199,3 +199,15 @@ def checksum_to_int(cs) -> int:
         return cs.to_int()
     a = np.asarray(cs, dtype=np.uint64)
     return int((a[0] << np.uint64(32)) | a[1])
+
+
+def checksum_peek(cs) -> "int | None":
+    """Non-blocking :func:`checksum_to_int`: the value if it can be read
+    without stalling the host (landed async copy, host-backed array), else
+    None.  The pipelined consume path — see snapshot/lazy.py."""
+    import numpy as np
+
+    if hasattr(cs, "peek"):
+        return cs.peek()
+    a = np.asarray(cs, dtype=np.uint64)
+    return int((a[0] << np.uint64(32)) | a[1])

@@ -21,6 +21,13 @@ Consequences, and the design here:
 - :class:`LazySlice` defers ``stacked[i]`` materialization of per-frame saved
   states: the snapshot ring stores (stacked-buffer, index) handles and only
   issues the slicing dispatches for the one frame a rollback actually loads.
+- :class:`ReadbackQueue` (the pipelined tick engine's harvest side) starts a
+  NON-blocking device->host copy per checksum batch at dispatch time
+  (``jax.Array.copy_to_host_async``) and collects landed values on later
+  ticks (``is_ready`` + cached host read) — so the per-frame ``send_checksum``
+  path never blocks on the device.  Blocking pulls still exist, but only at
+  flush points (``finish()``, ``set_session``, forensics) and as a GC-horizon
+  backstop; each one is counted as a *forced* readback.
 
 All of this is also correct (and nearly free) on CPU, where device_get is a
 memcpy.
@@ -28,10 +35,60 @@ memcpy.
 
 from __future__ import annotations
 
+import time
 import weakref
 from typing import Optional
 
 import numpy as np
+
+# Process-wide async-readback accounting.  Plain always-on counters (bench and
+# tests read these without enabling telemetry); mirrored into the telemetry
+# registry when it is enabled.
+_stats = {"harvested": 0, "forced": 0, "blocked_seconds": 0.0}
+
+# On the CPU backend device buffers ARE host memory: the staged
+# copy_to_host_async is pure dispatch-path overhead (a profiler-wrapped jax
+# call, ~60us/tick at small N) and np.asarray on a ready array is zero-copy,
+# so the harvest path only needs is_ready().  Real accelerators keep the
+# staged copy — that is what makes the later read non-blocking there.
+_skip_staged_copy: Optional[bool] = None
+
+
+def _staged_copy_needed() -> bool:
+    global _skip_staged_copy
+    if _skip_staged_copy is None:
+        try:
+            import jax
+            _skip_staged_copy = jax.devices()[0].platform == "cpu"
+        except Exception:  # pragma: no cover - no jax in stub-only tests
+            _skip_staged_copy = True
+    return not _skip_staged_copy
+
+
+def readback_stats() -> dict:
+    """Snapshot of {harvested, forced, blocked_seconds} since process start."""
+    return dict(_stats)
+
+
+def _note_readback(harvested: int = 0, forced: int = 0,
+                   blocked_s: float = 0.0) -> None:
+    _stats["harvested"] += harvested
+    _stats["forced"] += forced
+    _stats["blocked_seconds"] += blocked_s
+    from .. import telemetry
+
+    if harvested:
+        telemetry.count("readback_harvested_total", harvested,
+                        help="checksum readbacks collected without blocking "
+                             "(async copy had landed)")
+    if forced:
+        telemetry.count("readback_forced_total", forced,
+                        help="checksum readbacks that blocked the host "
+                             "(flush points / sync mode)")
+    if blocked_s:
+        telemetry.count("host_blocked_seconds", blocked_s,
+                        help="host seconds spent blocked in device->host "
+                             "checksum pulls")
 
 
 class BatchChecks:
@@ -40,12 +97,55 @@ class BatchChecks:
 
     _pending: "weakref.WeakSet[BatchChecks]" = weakref.WeakSet()
 
-    __slots__ = ("_dev", "_host", "__weakref__")
+    __slots__ = ("_dev", "_host", "_async", "__weakref__")
 
     def __init__(self, dev):
         self._dev = dev
         self._host: Optional[np.ndarray] = None
+        self._async = False
         BatchChecks._pending.add(self)
+
+    def start_async(self) -> None:
+        """Begin the non-blocking device->host copy for this batch.
+
+        Called at dispatch time by the pipelined runner; by the time a
+        session wants the value the transfer has usually landed and
+        :meth:`try_host` is a cached read.  No-op on objects without the
+        jax.Array async-copy surface (host-backed test stubs)."""
+        if self._host is not None or self._async:
+            return
+        if not _staged_copy_needed():
+            # CPU: harvest gates on is_ready() alone; adoption is zero-copy
+            self._async = True
+            return
+        copy = getattr(self._dev, "copy_to_host_async", None)
+        if copy is not None:
+            copy()
+            self._async = True
+
+    def _transfer_landed(self) -> bool:
+        """True when reading the device value would not block."""
+        ready = getattr(self._dev, "is_ready", None)
+        return bool(ready()) if ready is not None else True
+
+    def _adopt_host(self) -> None:
+        """Take the completed transfer (cached host copy / host-backed
+        array) without a meaningful block, and count the harvest."""
+        self._host = np.asarray(self._dev, dtype=np.uint64)
+        BatchChecks._pending.discard(self)
+        _note_readback(harvested=1)
+
+    def try_host(self) -> Optional[np.ndarray]:
+        """Non-blocking :meth:`host`: the uint64[k, 2] copy if it can be had
+        without stalling, else None.  Starts the async copy as a side effect
+        so un-started batches converge even without a pipelining runner."""
+        if self._host is not None:
+            return self._host
+        self.start_async()
+        if self._async and not self._transfer_landed():
+            return None
+        self._adopt_host()
+        return self._host
 
     def host(self) -> np.ndarray:
         """uint64[k, 2] host copy; first call pulls every pending batch."""
@@ -76,6 +176,11 @@ class BatchChecks:
         if not pending:
             cls._pending.clear()
             return
+        # Readback accounting: a pending batch whose async copy already
+        # landed is a harvest (this pull won't wait on it); the rest are
+        # forced (the host blocks until their dispatch completes).
+        landed = sum(1 for b in pending if b._async and b._transfer_landed())
+        t0 = time.perf_counter()
         # NOTE: batches leave the pending set only AFTER the pull succeeds —
         # if the device_get raises (flaky tunnel), every batch stays pending
         # and the next pull retries, instead of orphaning them with
@@ -84,16 +189,17 @@ class BatchChecks:
             pending[0]._host = np.asarray(
                 jax.device_get(pending[0]._dev), dtype=np.uint64
             )
-            cls._pending.clear()
-            return
-        fused = _concat_rows(*[b._dev for b in pending])
-        host = np.asarray(jax.device_get(fused), dtype=np.uint64)
-        off = 0
-        for b in pending:
-            k = b._dev.shape[0]
-            b._host = host[off:off + k]
-            off += k
+        else:
+            fused = _concat_rows(*[b._dev for b in pending])
+            host = np.asarray(jax.device_get(fused), dtype=np.uint64)
+            off = 0
+            for b in pending:
+                k = b._dev.shape[0]
+                b._host = host[off:off + k]
+                off += k
         cls._pending.clear()
+        _note_readback(harvested=landed, forced=len(pending) - landed,
+                       blocked_s=time.perf_counter() - t0)
 
 
 def _concat_rows(*xs):
@@ -125,6 +231,20 @@ class ChecksumRef:
         a = self._batch.host()[self._i]
         return int((a[0] << np.uint64(32)) | a[1])
 
+    # A ref IS the session's checksum provider: calling it forces (the flush
+    # paths), peek() is the non-blocking read the pipelined desync driver
+    # retries until the async copy lands.
+    __call__ = to_int
+
+    def peek(self) -> Optional[int]:
+        """Non-blocking :meth:`to_int`: the value if the batched device->host
+        copy has landed, else None (starting the copy if needed)."""
+        h = self._batch.try_host()
+        if h is None:
+            return None
+        a = h[self._i]
+        return int((a[0] << np.uint64(32)) | a[1])
+
     def device(self):
         """Lazy uint32[2] device row (a dispatch, not a transfer)."""
         return self._batch._dev[self._i]
@@ -137,6 +257,68 @@ class ChecksumRef:
 def wrap_single_checksum(cs) -> ChecksumRef:
     """Wrap a bare uint32[2] device checksum as a 1-row batch ref."""
     return BatchChecks(cs[None]).ref(0)
+
+
+class ReadbackQueue:
+    """The pipelined tick engine's readback coordinator.
+
+    ``start(batch)`` begins a non-blocking device->host copy right after a
+    dispatch; ``harvest()`` (called once per runner tick, and at the top of
+    the sessions' compare paths) finalizes every batch whose copy has landed
+    and async-starts any stragglers that entered the pending set some other
+    way (``wrap_single_checksum``, spec-cache batches).  ``flush()`` is the
+    blocking everything-now path for the existing flush points.
+
+    The :class:`BatchChecks` process-wide pending set is the queue — there is
+    no second registry to leak, and one queue instance serves every runner in
+    the process (the batched pull already fuses across them anyway)."""
+
+    def start(self, batch: BatchChecks) -> None:
+        batch.start_async()
+
+    def harvest(self) -> int:
+        """Finalize landed transfers; returns how many were collected."""
+        if not BatchChecks._pending:
+            return 0
+        n = 0
+        for b in list(BatchChecks._pending):
+            if b._host is not None:
+                BatchChecks._pending.discard(b)
+                continue
+            if not b._async:
+                b.start_async()
+                if not b._async:
+                    continue  # no async surface: leave for the forced path
+            if b._transfer_landed():
+                b._adopt_host()
+                n += 1
+        from .. import telemetry
+
+        if telemetry.enabled():
+            telemetry.gauge_set("pipeline_depth", float(self.depth()),
+                                help="checksum dispatches in flight "
+                                     "(async readbacks not yet landed)")
+        return n
+
+    def depth(self) -> int:
+        """Batches still in flight (pending and unharvested)."""
+        return sum(1 for b in BatchChecks._pending if b._host is None)
+
+    def flush(self) -> None:
+        """Blocking pull of everything still pending (flush points only;
+        counted as forced readbacks unless the copies already landed)."""
+        BatchChecks.pull_pending()
+
+
+_readback_queue: Optional[ReadbackQueue] = None
+
+
+def readback_queue() -> ReadbackQueue:
+    """The process-wide :class:`ReadbackQueue` singleton."""
+    global _readback_queue
+    if _readback_queue is None:
+        _readback_queue = ReadbackQueue()
+    return _readback_queue
 
 
 class LazySlice:

@@ -134,6 +134,10 @@ def summary() -> dict:
             "rollbacks_total": _total("rollbacks_total"),
             "resim_frames_total": _total("resim_frames_total"),
             "checksum_mismatch_total": _total("checksum_mismatch_total"),
+            "readback_harvested_total": _total("readback_harvested_total"),
+            "readback_forced_total": _total("readback_forced_total"),
+            "host_blocked_seconds": _total("host_blocked_seconds"),
+            "pipeline_degrade_total": _total("pipeline_degrade_total"),
         },
         "timeline_events": len(timeline()),
     }

@@ -3,7 +3,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint (unused imports) =="
+echo "== lint (unused imports + hot-loop purity) =="
 python scripts/lint_imports.py
 
 echo "== native build + tests =="

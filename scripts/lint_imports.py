@@ -1,11 +1,19 @@
 #!/usr/bin/env python
-"""Fast lint gate for CI: unused imports and obvious bind errors.
+"""Fast lint gate for CI: unused imports, obvious bind errors, and the
+hot-loop purity rule.
 
 Prefers ``pyflakes`` when it is importable (full undefined-name analysis);
 otherwise falls back to a stdlib-``ast`` checker that catches the highest
 value class of drift in a growing codebase — imports nobody uses anymore —
 plus duplicate function/class definitions in the same scope.  Zero
 third-party dependencies by design (the container forbids installs).
+
+The purity lint runs in BOTH modes: the pipelined tick engine
+(docs/architecture.md "Tick pipeline") depends on the hot loop never forcing
+a device->host sync — one stray ``block_until_ready`` / ``device_get`` /
+eager ``.to_int`` in the dispatch path re-serializes host against device and
+silently voids the overlap, with no test failing.  Forcing reads are allowed
+only inside the allowlisted harvest/flush functions below.
 
     python scripts/lint_imports.py [paths...]   # default: package+tests+scripts
 """
@@ -18,6 +26,77 @@ DEFAULT_PATHS = ("bevy_ggrs_tpu", "tests", "scripts", "bench.py")
 
 # re-export / intentional-import conventions that must not be flagged
 _ALLOW_UNUSED_IN = ("__init__.py",)
+
+# -- hot-loop purity --------------------------------------------------------
+# file (path suffix) -> functions allowed to force device->host reads
+PURITY_ALLOW = {
+    "bevy_ggrs_tpu/runner.py": {
+        "checksum",               # user-facing flush point (property)
+        "read_components",        # render readback (drains first)
+        "_drain_inflight",        # THE blocking point the others share
+        "_flush_session_checks",  # finish()/set_session flush
+    },
+    "bevy_ggrs_tpu/batch_runner.py": {
+        "lobby_checksum",         # user-facing flush point
+        "finish",                 # end-of-run flush
+    },
+    "bevy_ggrs_tpu/session/p2p.py": {
+        "check_now",              # finish()/set_session flush hook
+        "_resolve_checksum",      # the one sanctioned force/peek funnel
+    },
+}
+# attribute accesses that force (or can force) a device sync
+PURITY_ATTRS = {"to_int", "block_until_ready", "device_get"}
+# bare-name calls that force
+PURITY_NAMES = {"checksum_to_int"}
+
+
+def _purity_allowlist(path: Path):
+    """The allowlist for ``path`` if the purity lint covers it, else None."""
+    posix = path.as_posix()
+    for suffix, allow in PURITY_ALLOW.items():
+        if posix.endswith(suffix):
+            return allow
+    return None
+
+
+def check_purity(tree: ast.AST, allow: set) -> list:
+    """Return ``(line, message)`` for forcing reads outside ``allow``-listed
+    functions (attribute accesses count even un-called: holding a bound
+    ``.to_int`` and calling it later forces just the same)."""
+    problems = []
+
+    def walk(node, fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node.name
+        bad = None
+        if isinstance(node, ast.Attribute) and node.attr in PURITY_ATTRS:
+            bad = f".{node.attr}"
+        elif isinstance(node, ast.Name) and node.id in PURITY_NAMES:
+            bad = node.id
+        if bad is not None and fn not in allow:
+            problems.append((
+                node.lineno,
+                f"hot-loop purity: {bad} in {fn or '<module>'}() — forcing "
+                "device->host reads is allowed only in "
+                f"{sorted(allow)} (see docs/architecture.md tick pipeline)",
+            ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, fn)
+
+    walk(tree, None)
+    return problems
+
+
+def _check_purity_file(path: Path) -> list:
+    allow = _purity_allowlist(path)
+    if allow is None:
+        return []
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError:
+        return []  # the import lint reports the syntax error
+    return check_purity(tree, allow)
 
 
 def _names_loaded(tree: ast.AST) -> set:
@@ -102,14 +181,21 @@ def main(argv) -> int:
     """Lint the given paths; return a non-zero exit code on any finding."""
     paths = argv[1:] or list(DEFAULT_PATHS)
     files = _iter_files(paths)
+    # the purity lint runs regardless of which import checker is available
+    pure_bad = 0
+    for f in files:
+        for lineno, msg in _check_purity_file(f):
+            print(f"{f}:{lineno}: {msg}")
+            pure_bad += 1
     try:
         from pyflakes.api import checkPath
         from pyflakes.reporter import Reporter
 
         rep = Reporter(sys.stdout, sys.stderr)
         bad = sum(checkPath(str(f), rep) for f in files)
-        print(f"lint (pyflakes): {len(files)} files, {bad} problems")
-        return 1 if bad else 0
+        print(f"lint (pyflakes + purity): {len(files)} files, "
+              f"{bad + pure_bad} problems")
+        return 1 if bad + pure_bad else 0
     except ImportError:
         pass
     bad = 0
@@ -117,8 +203,9 @@ def main(argv) -> int:
         for lineno, msg in _check_file(f):
             print(f"{f}:{lineno}: {msg}")
             bad += 1
-    print(f"lint (stdlib ast): {len(files)} files, {bad} problems")
-    return 1 if bad else 0
+    print(f"lint (stdlib ast + purity): {len(files)} files, "
+          f"{bad + pure_bad} problems")
+    return 1 if bad + pure_bad else 0
 
 
 if __name__ == "__main__":

@@ -31,6 +31,10 @@ def main() -> None:
                     help="serve Prometheus /metrics on this port "
                          "(0 = any free port)")
     ap.add_argument("--metrics-host", default="127.0.0.1")
+    ap.add_argument("--join-token", default=None,
+                    help="shared-secret join token: JOINs not carrying it "
+                         "are rejected with a reason (off by default; see "
+                         "docs/architecture.md trust-model note)")
     args = ap.parse_args()
     exporter = None
     if args.metrics_port is not None:
@@ -43,7 +47,8 @@ def main() -> None:
             flush=True,
         )
     server = RoomServer(port=args.port, host=args.host,
-                        member_timeout_s=args.timeout)
+                        member_timeout_s=args.timeout,
+                        join_token=args.join_token)
     print(f"room server on {server.local_addr}", flush=True)
     last_report = 0.0
     try:

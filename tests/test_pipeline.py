@@ -1,0 +1,311 @@
+"""Pipelined tick engine: async checksum readback (harvest vs forced),
+late-landing checksum providers feeding p2p desync detection, sync-mode
+zero-deep semantics, persistent staging reuse, and the bench/lint support
+surfaces that guard the pipeline (trimmed-mean aggregation, hot-loop purity
+lint)."""
+
+import ast
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    DesyncDetection,
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    SyncTestSession,
+)
+from bevy_ggrs_tpu.models import box_game, stress
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.session.events import DesyncDetected
+from bevy_ggrs_tpu.snapshot.lazy import (
+    BatchChecks,
+    ReadbackQueue,
+    readback_stats,
+    wrap_single_checksum,
+)
+
+DT = 1.0 / 60.0
+
+
+def _stats_delta(before, after=None):
+    after = after if after is not None else readback_stats()
+    return {k: after[k] - before[k] for k in ("harvested", "forced")}
+
+
+# -- BatchChecks / ReadbackQueue units --------------------------------------
+
+
+def _device_batch(values):
+    """uint32[k, 2] device array from a list of (hi, lo) pairs."""
+    return jnp.asarray(np.asarray(values, np.uint32))
+
+
+def test_harvest_collects_landed_copy_without_forcing():
+    batch = BatchChecks(_device_batch([(1, 2)]))
+    rbq = ReadbackQueue()
+    rbq.start(batch)
+    jax.block_until_ready(batch._dev)  # the copy has certainly landed
+    before = readback_stats()
+    assert rbq.harvest() >= 1
+    delta = _stats_delta(before)
+    assert delta["harvested"] >= 1 and delta["forced"] == 0
+    assert batch.ref(0).to_int() == (1 << 32) | 2  # cached, still no force
+
+
+def test_pull_pending_counts_unstarted_batch_as_forced():
+    batch = BatchChecks(_device_batch([(3, 4)]))
+    before = readback_stats()
+    BatchChecks.pull_pending()
+    delta = _stats_delta(before)
+    assert delta["forced"] >= 1
+    assert batch.ref(0).to_int() == (3 << 32) | 4
+
+
+def test_checksum_ref_peek_converges_and_matches_call():
+    ref = wrap_single_checksum(jnp.asarray(np.asarray([7, 9], np.uint32)))
+    got = None
+    for _ in range(1000):
+        got = ref.peek()
+        if got is not None:
+            break
+    assert got == (7 << 32) | 9
+    assert ref() == got  # __call__ is to_int; now a cached read
+
+
+def test_host_backed_provider_needs_no_async_surface():
+    # spec-cache / test stubs hand plain numpy to BatchChecks — the harvest
+    # path must adopt them without an is_ready/copy_to_host_async surface
+    batch = BatchChecks(np.asarray([[5, 6]], np.uint32))
+    assert ReadbackQueue().harvest() >= 1
+    assert batch.ref(0).peek() == (5 << 32) | 6
+
+
+# -- SyncTest: pipeline on/off bit-equality and sync-mode semantics ----------
+
+
+def _synctest_checks(pipeline, ticks=30):
+    app = stress.make_app(128, capacity=128)
+    rng = np.random.default_rng(5)
+    runner = GgrsRunner(
+        app,
+        SyncTestSession(num_players=2, check_distance=2, compare_interval=1),
+        read_inputs=lambda hs: {h: np.uint8(rng.integers(0, 16)) for h in hs},
+        on_mismatch=lambda e: (_ for _ in ()).throw(e),
+        pipeline=pipeline,
+    )
+    checks = []
+    for _ in range(ticks):
+        runner.tick()
+        checks.append(runner.checksum)
+    runner.finish()
+    return checks
+
+
+def test_pipeline_on_off_checksums_bit_identical():
+    assert _synctest_checks(True) == _synctest_checks(False)
+
+
+def test_sync_mode_forces_readbacks_every_tick():
+    before = readback_stats()
+    _synctest_checks(False, ticks=10)
+    assert _stats_delta(before)["forced"] >= 10
+
+
+def test_pipeline_default_on_and_counted_in_stats():
+    app = stress.make_app(64, capacity=64)
+    runner = GgrsRunner(app, SyncTestSession(num_players=2))
+    assert runner.pipeline is True
+    assert runner.stats()["pipeline_degrades"] == 0
+    runner.finish()
+
+
+# -- p2p over deterministic channel ------------------------------------------
+
+
+def _channel_pair(pipeline=True, desync=DesyncDetection.on(1)):
+    net = ChannelNetwork(seed=7)
+    socks = [net.endpoint(f"p{i}") for i in range(2)]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(2)
+            .with_desync_detection_mode(desync)
+            .with_eager_checksums(not pipeline)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"p{1 - i}")
+        )
+        session = b.start_p2p_session(socks[i])
+        runners.append(GgrsRunner(
+            app, session,
+            read_inputs=lambda hs: {
+                h: box_game.keys_to_input(right=True) for h in hs
+            },
+            pipeline=pipeline,
+        ))
+    for _ in range(500):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING
+               for r in runners):
+            break
+    assert all(r.session.current_state() == SessionState.RUNNING
+               for r in runners)
+    return net, runners
+
+
+def _interleave(net, runners, ticks):
+    for _ in range(ticks):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+
+
+def test_pipelined_p2p_steady_state_never_forces():
+    net, runners = _channel_pair(pipeline=True)
+    _interleave(net, runners, 20)  # settle the startup transient
+    before = readback_stats()
+    _interleave(net, runners, 60)
+    delta = _stats_delta(before)
+    assert delta["forced"] == 0
+    assert delta["harvested"] > 0
+    desyncs = [e for r in runners for e in r.events
+               if isinstance(e, DesyncDetected)]
+    assert not desyncs
+    for r in runners:
+        r.finish()
+
+
+class _LateWrongRef:
+    """Checksum provider whose async copy 'lands' only after ``late`` polls —
+    and then reports a corrupted value."""
+
+    def __init__(self, value, late):
+        self.value = value
+        self.polls = 0
+        self.late = late
+
+    def peek(self):
+        self.polls += 1
+        return None if self.polls <= self.late else self.value
+
+    def __call__(self):
+        return self.value
+
+
+def test_late_checksum_still_desyncs_at_the_right_frame():
+    """Satellite (c): a local checksum that resolves k polls after the frame
+    is confirmed must still be published, compared, and fire DesyncDetected
+    carrying THAT frame — late readbacks delay detection, never drop it."""
+    net, runners = _channel_pair(pipeline=True)
+    _interleave(net, runners, 10)
+    sess = runners[1].session
+    target = {}
+    orig = sess._on_cell_saved
+
+    def corrupting_hook(frame, provider):
+        if not target and frame % 2 == 0:
+            target["frame"] = frame
+            target["ref"] = _LateWrongRef(value=0x0BAD_C0DE, late=6)
+            orig(frame, target["ref"])
+        else:
+            orig(frame, provider)
+
+    sess._on_cell_saved = corrupting_hook
+    _interleave(net, runners, 80)
+    assert "frame" in target, "hook never saw a save"
+    assert target["ref"].polls > 6, "provider was never re-polled after None"
+    desyncs = [e for r in runners for e in r.events
+               if isinstance(e, DesyncDetected)]
+    assert desyncs, "late-landing corrupted checksum produced no desync"
+    assert {e.frame for e in desyncs} == {target["frame"]}
+    for r in runners:
+        r.finish()
+
+
+def test_real_divergence_detected_with_pipelining_on():
+    net, runners = _channel_pair(pipeline=True, desync=DesyncDetection.on(2))
+    _interleave(net, runners, 20)
+    w = runners[1].world
+    runners[1].world = dataclasses.replace(
+        w, comps={**w.comps, "pos": w.comps["pos"] + 5.0}
+    )
+    runners[1]._world_checksum = runners[1].app.checksum_fn(runners[1].world)
+    _interleave(net, runners, 80)
+    desyncs = [e for r in runners for e in r.events
+               if isinstance(e, DesyncDetected)]
+    assert desyncs, "expected DesyncDetected after state divergence"
+    for r in runners:
+        r.finish()
+
+
+# -- runner integration: staging reuse, read_components ---------------------
+
+
+def test_persistent_staging_buffer_is_reused():
+    net, runners = _channel_pair(pipeline=True, desync=DesyncDetection.OFF)
+    _interleave(net, runners, 10)
+    buf = runners[0]._stage_inputs
+    assert buf is not None
+    _interleave(net, runners, 10)
+    assert runners[0]._stage_inputs is buf, (
+        "solo-runner staging must reuse its persistent buffer, not "
+        "reallocate per tick"
+    )
+    for r in runners:
+        r.finish()
+
+
+def test_read_components_drains_inflight_window():
+    net, runners = _channel_pair(pipeline=True, desync=DesyncDetection.OFF)
+    _interleave(net, runners, 15)
+    r = runners[0]
+    out = r.read_components(["pos"])
+    assert np.array_equal(out["pos"], np.asarray(r.world.comps["pos"]))
+    assert "__active__" in out
+    for r in runners:
+        r.finish()
+
+
+# -- support surfaces: bench aggregation, purity lint ------------------------
+
+
+def test_trimmed_mean_drops_single_outlier():
+    bench = pytest.importorskip("bench")
+    samples = [100.0, 101.0, 99.0, 250.0]  # one contention-mauled rep
+    val, spread, spread_raw = bench._trimmed_mean_spread(samples)
+    assert val == pytest.approx(100.5)
+    assert spread < 0.03
+    assert spread_raw > 1.0  # the outlier stays visible in the raw spread
+    # below 4 reps there is nothing to trim
+    val3, _, _ = bench._trimmed_mean_spread([1.0, 2.0, 3.0])
+    assert val3 == pytest.approx(2.0)
+
+
+def test_purity_lint_flags_forcing_read_outside_allowlist():
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_imports", Path(__file__).parent.parent / "scripts/lint_imports.py"
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    bad = ast.parse(
+        "def hot_loop(ref):\n"
+        "    return ref.to_int()\n"
+        "def sanctioned(ref):\n"
+        "    return ref.to_int()\n"
+    )
+    problems = lint.check_purity(bad, allow={"sanctioned"})
+    assert len(problems) == 1
+    assert problems[0][0] == 2  # the hot_loop line, not the allowlisted one

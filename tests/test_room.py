@@ -317,3 +317,56 @@ def test_move_to_full_room_keeps_old_membership():
         blocker.close()
     finally:
         room_mod.MAX_ROOM_MEMBERS = old_cap
+
+
+def test_join_token_matching_clients_pair_up():
+    server = RoomServer(host="127.0.0.1", join_token="s3cret")
+    addr = server.local_addr
+    socks = [
+        RoomSocket(addr, "locked", peer_id=f"peer-{i}", host="127.0.0.1",
+                   join_token="s3cret")
+        for i in range(2)
+    ]
+    for s in socks:
+        assert wait_for_players(s, 2, timeout_s=5.0, server=server) == [
+            "peer-0", "peer-1"
+        ]
+    server.close()
+    for s in socks:
+        s.close()
+
+
+def test_join_token_mismatch_rejected_with_reason():
+    server = RoomServer(host="127.0.0.1", join_token="s3cret")
+    addr = server.local_addr
+    s = RoomSocket(addr, "locked", peer_id="intruder", host="127.0.0.1",
+                   join_token="wrong")
+    with pytest.raises(PermissionError, match="bad join token"):
+        wait_for_players(s, 1, timeout_s=5.0, server=server)
+    assert server.rooms.get("locked") in (None, {})
+    server.close()
+    s.close()
+
+
+def test_join_token_absent_client_rejected_by_token_server():
+    # a pre-token client sends no trailing token field; a token-requiring
+    # server must still refuse it (empty != configured token)
+    server = RoomServer(host="127.0.0.1", join_token="s3cret")
+    addr = server.local_addr
+    s = RoomSocket(addr, "locked", peer_id="legacy", host="127.0.0.1")
+    with pytest.raises(PermissionError, match="bad join token"):
+        wait_for_players(s, 1, timeout_s=5.0, server=server)
+    server.close()
+    s.close()
+
+
+def test_token_client_compatible_with_tokenless_server():
+    # forward compat: the trailing token field is ignored by servers that
+    # never configured one
+    server = RoomServer(host="127.0.0.1")
+    addr = server.local_addr
+    s = RoomSocket(addr, "open", peer_id="newcli", host="127.0.0.1",
+                   join_token="s3cret")
+    assert wait_for_players(s, 1, timeout_s=5.0, server=server) == ["newcli"]
+    server.close()
+    s.close()
