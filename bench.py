@@ -375,6 +375,183 @@ def _dispatch_flatness_gate(smoke: bool) -> dict:
     }
 
 
+SHARDED_LOBBIES = 16
+
+
+def stage_sharded():
+    """Device-sharded many-worlds executor: lobbies across the mesh.
+
+    Two parts (mirroring :func:`stage_batched`, which this stage extends to
+    a ``"lobby"`` device mesh — docs/architecture.md "Many-worlds
+    sharding"):
+
+    1. THROUGHPUT — the 16-lobby x 8-frame wave dispatched through a
+       1-device ``BucketedWaveExecutor`` (the D=1 arm) and through a
+       ``ShardedWaveExecutor`` over every visible device (D=8 virtual CPU
+       devices in CI; real chips on a pod slice).  Reports aggregate
+       lobby-frames/s per arm with the trimmed-mean rep aggregation, the
+       D-speedup ratio, and the per-device buffer residency from the
+       executor's ``harvest_shards`` probe (REAL per-device metrics — the
+       multichip harness records these, scripts/multichip_bench.py).  On a
+       1-core CPU host the D=8 arm measures dispatch overhead, not
+       parallel speedup — the ratio is reported, never gated.
+    2. FLATNESS GATE — ``BatchedRunner(mesh=...)`` drives M=8 and M=32
+       lockstep SyncTest lobbies; the stage HARD-FAILS unless the
+       steady-state per-device dispatch count per tick is identical at
+       both lobby counts (each SPMD wave is exactly one dispatch per
+       device, so runner dispatches == per-device dispatches).
+
+    Needs >= 2 devices; single-device backends report
+    ``sharded_skipped`` (the multichip harness marks that run ``skipped``,
+    never ``ok``).  ``BGT_BENCH_SMOKE=1`` shrinks to a seconds-long CI run
+    with the gate fully armed."""
+    # must precede backend init: split the CPU platform into 8 virtual
+    # devices (ignored by real TPU backends — the flag only affects the
+    # host platform)
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    jax = _stage_setup()
+    from bevy_ggrs_tpu.models import stress_soa
+    from bevy_ggrs_tpu.ops.batch import (
+        BucketedWaveExecutor, ShardedWaveExecutor, stack_worlds,
+    )
+    from bevy_ggrs_tpu.parallel import make_lobby_mesh
+    from bevy_ggrs_tpu.session.events import InputStatus
+
+    n_dev = len(jax.devices())
+    plat = jax.devices()[0].platform
+    if n_dev < 2:
+        return {
+            "sharded_skipped": f"single-device backend ({plat})",
+            "platform": plat,
+        }
+    smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
+    reps = 1 if smoke else REPS
+    iters = 5 if smoke else ITERS
+    warmup_reps = 1 if smoke else 2
+    n_ent = 2000 if smoke else N_ENTITIES
+
+    app = stress_soa.make_app(n_ent)
+    mesh = make_lobby_mesh(n_dev)
+    inputs = np.zeros((SHARDED_LOBBIES, DEPTH, 2), np.uint8)
+    status = np.full((SHARDED_LOBBIES, DEPTH, 2), InputStatus.CONFIRMED,
+                     np.int8)
+    ks = [DEPTH] * SHARDED_LOBBIES
+    frames = np.zeros((SHARDED_LOBBIES,), np.int32)
+    arms = {}
+    per_device = None
+    for d, ex in ((1, BucketedWaveExecutor(app, DEPTH)),
+                  (n_dev, ShardedWaveExecutor(app, DEPTH, mesh))):
+        worlds = stack_worlds(
+            [app.init_state() for _ in range(SHARDED_LOBBIES)]
+        )
+        samples = []
+        for rep in range(warmup_reps + reps):
+            t0 = time.perf_counter()
+            w = worlds
+            for i in range(iters):
+                _bkt, w, _stk, _chk = ex.run_wave(
+                    w, inputs, status, frames + i * DEPTH, ks
+                )
+            jax.block_until_ready(w)
+            if rep >= warmup_reps:
+                samples.append(
+                    SHARDED_LOBBIES * DEPTH * iters
+                    / (time.perf_counter() - t0)
+                )
+        agg, spread, spread_raw = _trimmed_mean_spread(samples)
+        arms[d] = {"agg_fps": round(agg, 1), "spread": round(spread, 3),
+                   "spread_raw": round(spread_raw, 3)}
+        if d > 1:
+            per_device = ex.harvest_shards(w)
+
+    gate = _sharded_flatness_gate(smoke, mesh)
+    return {
+        "sharded_lobbies": SHARDED_LOBBIES,
+        "sharded_entities": n_ent,
+        "sharded_devices": n_dev,
+        "sharded_agg_fps_d1": arms[1]["agg_fps"],
+        "sharded_agg_fps_dN": arms[n_dev]["agg_fps"],
+        "sharded_speedup_dN_vs_d1": round(
+            arms[n_dev]["agg_fps"] / arms[1]["agg_fps"], 3
+        ),
+        "sharded_spread": arms[n_dev]["spread"],
+        "sharded_spread_raw": arms[n_dev]["spread_raw"],
+        "sharded_rep_policy": _rep_policy(reps, warmup_reps, iters),
+        "sharded_per_device": per_device,
+        **gate,
+        "platform": plat,
+    }
+
+
+def _sharded_flatness_gate(smoke: bool, mesh) -> dict:
+    """Drive BatchedRunner(mesh=...) at M=8 and M=32 lockstep SyncTest
+    lobbies and HARD-FAIL unless per-device dispatches per steady-state
+    tick are equal — the sharded O(1)-in-M acceptance gate (each SPMD wave
+    costs exactly one dispatch on every device, so the runner's dispatch
+    count IS the per-device count)."""
+    from bevy_ggrs_tpu import BatchedRunner, SyncTestSession, telemetry
+    from bevy_ggrs_tpu.models import stress
+
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    warm, meas = (2, 4) if smoke else (4, 8)
+    per_tick = {}
+    sharded_stats = None
+    for m in (8, 32):
+        app = stress.make_app(64, capacity=64)
+        sessions = [
+            SyncTestSession(num_players=2, input_shape=(),
+                            input_dtype=np.uint8, check_distance=2,
+                            compare_interval=1)
+            for _ in range(m)
+        ]
+        br = BatchedRunner(
+            app, sessions,
+            read_inputs=lambda lobby, handles: {
+                h: np.uint8((lobby + h) & 0xF) for h in handles
+            },
+            mesh=mesh,
+        )
+        for _ in range(warm):
+            br.tick()
+        d0 = br.device_dispatches
+        for _ in range(meas):
+            br.tick()
+        br.finish()
+        per_tick[m] = (br.device_dispatches - d0) / meas
+        if m == 32:
+            sharded_stats = br.stats().get("sharded")
+    reg = telemetry.registry()
+    tel = {
+        "sharded_wave_dispatches_total": reg.counter(
+            "sharded_wave_dispatches_total").value(),
+        "shard_program_compiles_total": reg.counter(
+            "shard_program_compiles_total").value(),
+        "shard_imbalance_ratio": reg.gauge("shard_imbalance_ratio").value(),
+    }
+    telemetry.disable()
+    telemetry.reset()
+    if per_tick[8] != per_tick[32]:
+        raise RuntimeError(
+            "sharded O(1)-dispatch gate FAILED: per-device dispatches per "
+            f"tick scale with lobby count: {per_tick}"
+        )
+    return {
+        "sharded_dispatches_per_device_tick": {
+            str(m): v for m, v in per_tick.items()
+        },
+        "sharded_runner_stats": sharded_stats,
+        "sharded_telemetry": tel,
+    }
+
+
 def stage_canonical():
     """Bit-determinism mode (fixed k=16 padded program) throughput."""
     jax = _stage_setup()
@@ -648,6 +825,7 @@ STAGES = {
     "resim100k": (stage_resim100k, 420),
     "resim1m": (stage_resim1m, 600),
     "batched": (stage_batched, 600),
+    "sharded": (stage_sharded, 600),
     "canonical": (stage_canonical, 420),
     "speculation": (stage_speculation, 420),
     "layouts": (stage_layouts, 420),
@@ -846,6 +1024,22 @@ def orchestrate():
         "batched_program_compiles": merged.get("batched_program_compiles"),
         "batched_jit_entries": merged.get("batched_jit_entries"),
         "batched_telemetry": merged.get("batched_telemetry"),
+        "sharded": {
+            "devices": merged.get("sharded_devices"),
+            "lobbies": merged.get("sharded_lobbies"),
+            "agg_fps_d1": merged.get("sharded_agg_fps_d1"),
+            "agg_fps_dN": merged.get("sharded_agg_fps_dN"),
+            "speedup_dN_vs_d1": merged.get("sharded_speedup_dN_vs_d1"),
+            "spread": merged.get("sharded_spread"),
+            "spread_raw": merged.get("sharded_spread_raw"),
+            "rep_policy": merged.get("sharded_rep_policy"),
+            "per_device": merged.get("sharded_per_device"),
+            "dispatches_per_device_tick": merged.get(
+                "sharded_dispatches_per_device_tick"),
+            "runner_stats": merged.get("sharded_runner_stats"),
+            "telemetry": merged.get("sharded_telemetry"),
+            "skipped": merged.get("sharded_skipped"),
+        },
         "rep_policy_10k": merged.get("rep_policy_10k"),
         "bench_env": BENCH_MALLOC_ENV,
         "speculative_lane0_useful_fps": merged.get("spec_fps"),
@@ -898,9 +1092,11 @@ def orchestrate():
 
 
 def smoke():
-    """CI smoke: the batched stage only, 1 rep, small iter counts — seconds,
-    not minutes — with the O(1)-dispatch gate fully armed (a dispatch-count
-    regression fails this run).  Wired into scripts/check.sh."""
+    """CI smoke: the batched + sharded stages only, 1 rep, small iter counts
+    — seconds, not minutes — with BOTH O(1)-dispatch gates fully armed (a
+    dispatch-count regression in either executor fails this run).  The
+    sharded stage runs under forced 8-virtual-device CPU so the mesh path
+    is exercised even on single-chip hosts.  Wired into scripts/check.sh."""
     result, err = _run_stage(
         "batched", timeout_s=300, force_cpu=False,
         extra_env={"BGT_BENCH_SMOKE": "1"},
@@ -908,14 +1104,28 @@ def smoke():
     if result is None:
         print(f"bench smoke FAILED: {err}", file=sys.stderr)
         sys.exit(1)
-    print(json.dumps({"smoke": "ok", **result}))
+    sharded, err = _run_stage(
+        "sharded", timeout_s=300, force_cpu=True,
+        extra_env={"BGT_BENCH_SMOKE": "1", "BGT_CPU_DEVICES": "8"},
+    )
+    if sharded is None:
+        print(f"bench smoke FAILED (sharded stage): {err}", file=sys.stderr)
+        sys.exit(1)
+    if sharded.get("sharded_skipped"):
+        print(f"bench smoke FAILED: sharded stage skipped under forced "
+              f"8-device CPU: {sharded['sharded_skipped']}", file=sys.stderr)
+        sys.exit(1)
+    print(json.dumps({"smoke": "ok", **result,
+                      "sharded": {k: v for k, v in sharded.items()
+                                  if k != "platform"}}))
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", choices=sorted(STAGES), default=None)
     ap.add_argument("--smoke", action="store_true",
-                    help="batched stage only, 1 rep, dispatch gate armed")
+                    help="batched + sharded stages only, 1 rep, "
+                         "dispatch gates armed")
     args = ap.parse_args()
     if args.stage:
         from bevy_ggrs_tpu.utils.platform import apply_platform_env

@@ -52,7 +52,7 @@ import numpy as np
 
 from . import telemetry
 from .app import App
-from .ops.batch import BucketedWaveExecutor, stack_worlds
+from .ops.batch import BucketedWaveExecutor, ShardedWaveExecutor, stack_worlds
 from .session.events import (
     DesyncDetected,
     MismatchedChecksumError,
@@ -103,8 +103,71 @@ def _split_ops(requests: List[GgrsRequest]) -> List[_Op]:
     return ops
 
 
+class ShardPlanner:
+    """Host-side shard accounting for the lobby-sharded executor.
+
+    Lobby lanes map to devices STATICALLY — lobby ``b`` lives on device
+    ``b // (m_pad / D)`` (contiguous blocks, the layout shard_map splits the
+    stacked world into) — so the "packing" decision the planner owns is the
+    per-tick bucket shape: it derives each device's active-lane count and
+    hottest advance depth from the wave's ``ks``, publishes the tick's
+    ``shard_imbalance_ratio`` gauge (max/mean active lobbies per device —
+    1.0 is a perfectly flat wave), and tracks the worst ratio seen.  A
+    ratio that stays high is the signal to re-home lobbies across devices
+    (a roadmap item — re-homing moves resident state, so it must be rare
+    and amortized, not per-tick)."""
+
+    def __init__(self, n_lobbies: int, n_devices: int):
+        self.n_lobbies = int(n_lobbies)
+        self.n_devices = int(n_devices)
+        self.m_pad = -(-self.n_lobbies // self.n_devices) * self.n_devices
+        self.lanes_per_shard = self.m_pad // self.n_devices
+        self.last_imbalance = 1.0
+        self.max_imbalance = 1.0
+        self.waves_planned = 0
+        self._g_imbalance = telemetry.registry().bind_gauge(
+            "shard_imbalance_ratio",
+            "max/mean active lobbies per device for the tick's run wave",
+        )
+
+    def shard_of(self, b: int) -> int:
+        """Device index owning lobby lane ``b``."""
+        return b // self.lanes_per_shard
+
+    def plan(self, ks: Sequence[int]) -> dict:
+        """Pack one wave's per-lobby advance counts into per-device
+        buckets; returns ``{"active_per_shard", "k_hot_per_shard",
+        "imbalance_ratio"}`` and publishes the gauge."""
+        active = [0] * self.n_devices
+        hot = [0] * self.n_devices
+        for b, k in enumerate(ks):
+            if k > 0:
+                d = self.shard_of(b)
+                active[d] += 1
+                hot[d] = max(hot[d], k)
+        total = sum(active)
+        ratio = (max(active) * self.n_devices / total) if total else 1.0
+        self.last_imbalance = ratio
+        self.max_imbalance = max(self.max_imbalance, ratio)
+        self.waves_planned += 1
+        self._g_imbalance.set(ratio)
+        return {
+            "active_per_shard": active,
+            "k_hot_per_shard": hot,
+            "imbalance_ratio": ratio,
+        }
+
+
 class BatchedRunner:
-    """M lobbies, one fused device dispatch per wave (module docstring)."""
+    """M lobbies, one fused device dispatch per wave (module docstring).
+
+    With ``mesh=`` (a ``parallel.make_lobby_mesh()`` handle) the lobby axis
+    additionally shards across the mesh's devices: the resident stacked
+    world is padded to a device-count multiple, placed with lobby-axis
+    sharding, and every wave dispatches through the
+    :class:`~.ops.batch.ShardedWaveExecutor` — O(1) dispatches PER DEVICE
+    per tick.  Falls back to the single-device executor automatically when
+    the mesh has one device or the process sees only one device."""
 
     def __init__(
         self,
@@ -115,6 +178,7 @@ class BatchedRunner:
         on_event: Optional[Callable[[int, object], None]] = None,
         k_max: Optional[int] = None,
         pipeline: bool = True,
+        mesh=None,
     ):
         if app.canonical_depth is not None or app.canonical_branches is not None:
             raise ValueError(
@@ -147,13 +211,39 @@ class BatchedRunner:
         )
         self.on_mismatch = on_mismatch
         self.on_event = on_event
-        self.worlds = stack_worlds([app.init_state() for _ in range(m)])
+        # lobby-mesh sharding: only engage when the mesh actually spans
+        # multiple devices AND the process can see them (single-device
+        # fallback keeps laptops/1-chip hosts on the proven path)
+        self.mesh = None
+        self.planner: Optional[ShardPlanner] = None
+        if mesh is not None and int(mesh.devices.size) > 1:
+            import jax as _jx
+
+            if len(_jx.devices()) > 1:
+                self.mesh = mesh
+        if self.mesh is not None:
+            self.planner = ShardPlanner(m, int(self.mesh.devices.size))
+            m_pad = self.planner.m_pad
+        else:
+            m_pad = m
+        self._m_pad = m_pad
+        # resident world: padded to a device-count multiple in sharded mode
+        # (pad lanes are permanently idle — every wave masks them at
+        # n_real=0, no session ever maps to them) and placed with lobby-axis
+        # sharding so each device owns its contiguous block of lanes
+        self.worlds = stack_worlds([app.init_state() for _ in range(m_pad)])
         # shape-bucketed wave programs replace the single k_max-deep padded
         # fn: a 1-advance lockstep wave dispatches the exact k=1 program, a
         # ragged rollback wave the smallest masked bucket covering it.
         # recycle_outputs stays OFF here — the rings below hold LazySlice
         # handles into past stacked outputs, so they must never be donated.
-        self.exec = BucketedWaveExecutor(app, self.k_max)
+        if self.mesh is not None:
+            from .parallel.mesh import shard_lobby_worlds
+
+            self.worlds = shard_lobby_worlds(self.mesh, self.worlds)
+            self.exec = ShardedWaveExecutor(app, self.k_max, self.mesh)
+        else:
+            self.exec = BucketedWaveExecutor(app, self.k_max)
         # per-lobby live-world checksum handles (ONE vmapped dispatch for
         # all M rows; leading saves reuse these instead of dispatching)
         import jax as _jax
@@ -192,10 +282,10 @@ class BatchedRunner:
         # stale rows — the padded program's n_real mask discards them, the
         # exact program never sees them.
         self._stage_inputs = np.zeros(
-            (m, self.k_max, self._np, *app.input_shape), app.input_dtype
+            (m_pad, self.k_max, self._np, *app.input_shape), app.input_dtype
         )
-        self._stage_status = np.zeros((m, self.k_max, self._np), np.int8)
-        self._stage_starts = np.zeros((m,), np.int32)
+        self._stage_status = np.zeros((m_pad, self.k_max, self._np), np.int8)
+        self._stage_starts = np.zeros((m_pad,), np.int32)
         # stable bound-method refs: snapshot-strategy hooks fused into the
         # batched load/save programs (and the jit-cache keys of
         # fused_load_rows / fused_gather_rows)
@@ -395,7 +485,7 @@ class BatchedRunner:
             # n_real — but keeping them finite avoids garbage-driven traps)
             inputs, status = self._stage_inputs, self._stage_status
             starts = self._stage_starts
-            starts[:] = self.frames
+            starts[:m] = self.frames  # pad lanes (sharded mode) keep 0
             for b, a in enumerate(adv):
                 kb = len(a)
                 if not kb:
@@ -414,9 +504,18 @@ class BatchedRunner:
                 "dispatch", batched=True, k_hot=k_hot,
                 active_lobbies=sum(1 for k in ks if k > 0),
             )
+            # sharded mode: the planner packs the wave into per-device
+            # buckets (gauge + imbalance tracking) and the executor sees
+            # the full padded lane list so its M is device-divisible (the
+            # resident world/staging are already padded — no per-wave
+            # pad/trim dispatches on this path)
+            wave_ks = ks
+            if self.planner is not None:
+                self.planner.plan(ks)
+                wave_ks = ks + [0] * (self._m_pad - m)
             with span("AdvanceWorldBatched"):
                 bucket, finals, stacked, checks_flat = self.exec.run_wave(
-                    self.worlds, inputs, status, starts, ks
+                    self.worlds, inputs, status, starts, wave_ks
                 )
                 batch = BatchChecks(checks_flat)
                 if self.pipeline:
@@ -509,6 +608,15 @@ class BatchedRunner:
             "frames": list(self.frames),
             "confirmed": list(self.confirmed),
         }
+        if self.planner is not None:
+            out["sharded"] = {
+                "devices": self.planner.n_devices,
+                "lanes_per_shard": self.planner.lanes_per_shard,
+                "pad_lanes": self._m_pad - len(self.sessions),
+                "imbalance_last": round(self.planner.last_imbalance, 4),
+                "imbalance_max": round(self.planner.max_imbalance, 4),
+                "waves_planned": self.planner.waves_planned,
+            }
         out.update(self.exec.stats())
         return out
 

@@ -31,6 +31,7 @@ from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..snapshot.world import WorldState
 from .resim import resim, resim_padded
@@ -327,8 +328,6 @@ class BucketedWaveExecutor:
                     worlds, inp, st, starts
                 )
         else:
-            import numpy as np
-
             n_real = np.asarray(ks, np.int32)
             finals, stacked, checks = self._get_fn("padded", bucket)(
                 worlds, inp, st, starts, n_real
@@ -350,3 +349,300 @@ class BucketedWaveExecutor:
             "bucket_hist": {k: v for k, v in self.bucket_hist.items() if v},
             "jit_entries": jit_entries,
         }
+
+
+# -- device-sharded many-worlds executor -------------------------------------
+
+def make_sharded_padded_fn(app, k: int, mesh, *, unroll: int = 1,
+                           fused_checksums: bool = False):
+    """The ``n_real``-masked bucketed wave program sharded over a
+    ``"lobby"`` mesh axis via ``shard_map``.
+
+    Each device receives its contiguous ``M/D`` block of lobby lanes and
+    runs ``vmap(resim_padded)`` over them — the SAME SPMD program on every
+    device, so a wave of M lobbies on D devices costs one dispatch per
+    device instead of one device doing all M lanes.  Lobbies never
+    communicate, so the body contains NO collectives; the checksum
+    post-pass (``fused_checksums``) runs per-lane inside the shard, which
+    keeps it bit-exact (the uint32 wrapping-add reduction never crosses a
+    shard boundary).  Signature matches :func:`make_batched_padded_fn`
+    with M divisible by the mesh size (the executor pads)."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import LOBBY_AXIS
+
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+    spec = P(LOBBY_AXIS)
+
+    def local(batched_world, inputs_b, status_b, start_frames, n_real):
+        return jax.vmap(
+            lambda w, inp, st, f, nr: resim_padded(
+                reg, step, w, inp, st, f, nr, retention, fps, seed,
+                unroll=unroll, fused_checksums=fused_checksums,
+            )
+        )(batched_world, inputs_b, status_b, start_frames, n_real)
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_rep=False,  # no replication to track: lanes are independent
+    )
+
+    def body(batched_world, inputs_b, status_b, start_frames, n_real):
+        finals, stacked, checks = sharded(
+            batched_world, inputs_b, status_b, start_frames, n_real
+        )
+        return finals, stacked, checks.reshape(-1, 2)
+
+    return jax.jit(body)
+
+
+def make_sharded_exact_fn(app, k: int, mesh, *, unroll: int = 1,
+                          fused_checksums: bool = False):
+    """Exact-depth (unmasked) wave program over the ``"lobby"`` mesh axis —
+    the sharded analog of :func:`make_batched_exact_fn` (no
+    ``donate_outputs`` variant: output recycling and cross-device layout
+    donation do not compose safely, and the sharded path's win is dispatch
+    parallelism, not allocator churn)."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import LOBBY_AXIS
+
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+    spec = P(LOBBY_AXIS)
+
+    def local(batched_world, inputs_b, status_b, start_frames):
+        return jax.vmap(
+            lambda w, inp, st, f: resim(
+                reg, step, w, inp, st, f, retention, fps, seed,
+                unroll=unroll, fused_checksums=fused_checksums,
+            )
+        )(batched_world, inputs_b, status_b, start_frames)
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+        check_rep=False,
+    )
+
+    def body(batched_world, inputs_b, status_b, start_frames):
+        finals, stacked, checks = sharded(
+            batched_world, inputs_b, status_b, start_frames
+        )
+        return finals, stacked, checks.reshape(-1, 2)
+
+    return jax.jit(body)
+
+
+class ShardedWaveExecutor(BucketedWaveExecutor):
+    """:class:`BucketedWaveExecutor` whose wave programs shard the lobby
+    axis over a device mesh — the many-lobbies-across-the-mesh executor
+    (docs/architecture.md "Many-worlds sharding").
+
+    Same bucket/kind cache and :meth:`run_wave` contract as the parent;
+    the differences:
+
+    - programs come from :func:`make_sharded_padded_fn` /
+      :func:`make_sharded_exact_fn`: one SPMD dispatch drives every device,
+      each owning a contiguous ``M_pad / D`` block of lobby lanes;
+    - waves whose lobby count M is NOT divisible by the device count D are
+      padded to ``M_pad = ceil(M/D) * D`` with idle lanes (``n_real = 0``
+      — masked out by the padded program) and the outputs are trimmed back
+      to M rows in one extra jitted dispatch.  Callers that control their
+      resident world (BatchedRunner) pre-pad to M_pad so the steady state
+      never pays the pad/trim pair;
+    - ``recycle_outputs`` is refused (donating sharded outputs across waves
+      is not supported);
+    - dispatch/compile counts surface through the
+      ``sharded_wave_dispatches_total`` / ``shard_program_compiles_total``
+      telemetry counters (pre-bound) alongside the parent's plain-int
+      attributes, and :meth:`stats` adds the device count.
+
+    Bit-exactness: shard_map hands each device the identical per-lane
+    program the unsharded vmap runs, and lanes never communicate, so for
+    variant-stable sims the sharded wave is bit-identical to the unsharded
+    one — enforced by tests/test_sharded_wave.py against
+    :class:`BucketedWaveExecutor` on identical waves.
+    """
+
+    def __init__(self, app, k_max: int, mesh, *, unroll: int = 2,
+                 fused_checksums: bool = True, recycle_outputs: bool = False):
+        if recycle_outputs:
+            raise ValueError(
+                "ShardedWaveExecutor does not support recycle_outputs "
+                "(cross-wave donation of lobby-sharded buffers)"
+            )
+        super().__init__(app, k_max, unroll=unroll,
+                         fused_checksums=fused_checksums)
+        self.mesh = mesh
+        self.n_devices = int(mesh.devices.size)
+        from .. import telemetry
+
+        _reg = telemetry.registry()
+        self._m_sharded_dispatches = _reg.bind_counter(
+            "sharded_wave_dispatches_total",
+            "wave dispatches through the lobby-sharded executor",
+        )
+        self._m_shard_compiles = _reg.bind_counter(
+            "shard_program_compiles_total",
+            "lobby-sharded wave programs built (kind x bucket)",
+        )
+        self._trim_fns: Dict[Tuple[int, int, int], object] = {}
+
+    def pad_lobbies(self, m: int) -> int:
+        """Smallest multiple of the device count >= ``m``."""
+        d = self.n_devices
+        return -(-m // d) * d
+
+    def _get_fn(self, kind: str, bucket: int):
+        fn = self._fns.get((kind, bucket))
+        if fn is None:
+            if kind == "exact":
+                fn = make_sharded_exact_fn(
+                    self.app, bucket, self.mesh, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums,
+                )
+            elif kind == "padded":
+                fn = make_sharded_padded_fn(
+                    self.app, bucket, self.mesh, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums,
+                )
+            else:  # pragma: no cover - parent never asks for exact_recycle
+                raise ValueError(f"sharded executor has no {kind!r} programs")
+            self._fns[(kind, bucket)] = fn
+            self.compile_count += 1
+            self._m_compiles.inc()
+            self._m_shard_compiles.inc()
+        return fn
+
+    def run_wave(self, worlds, inputs, status, starts, ks):
+        """Dispatch one lobby-sharded wave (same contract as the parent:
+        returns ``(bucket, finals, stacked, checks_flat)`` with
+        ``checks_flat`` rows at ``b * bucket + i`` over the CALLER's M
+        lobbies).  Pads M to a device-count multiple when needed; padded
+        lanes ride the masked program at ``n_real = 0`` and are trimmed
+        from the outputs before returning."""
+        ks = list(ks)
+        m = len(ks)
+        m_pad = self.pad_lobbies(m)
+        pad = m_pad - m
+        if pad:
+            worlds = _pad_rows(worlds, pad)
+            inputs = np.concatenate(
+                [inputs, np.broadcast_to(inputs[-1:], (pad, *inputs.shape[1:]))]
+            )
+            status = np.concatenate(
+                [status, np.broadcast_to(status[-1:], (pad, *status.shape[1:]))]
+            )
+            starts = np.concatenate(
+                [np.asarray(starts, np.int32), np.zeros((pad,), np.int32)]
+            )
+            ks = ks + [0] * pad
+        k_hot = max(ks)
+        if k_hot <= 0:
+            raise ValueError("run_wave needs at least one advancing lobby")
+        bucket = self.bucket_for(k_hot)
+        exact = all(k == bucket for k in ks)
+        inp = inputs[:, :bucket]
+        st = status[:, :bucket]
+        self.dispatch_count += 1
+        self.bucket_hist[bucket] += 1
+        self._m_dispatches.inc()
+        self._m_sharded_dispatches.inc()
+        if exact:
+            finals, stacked, checks = self._get_fn("exact", bucket)(
+                worlds, inp, st, starts
+            )
+        else:
+            n_real = np.asarray(ks, np.int32)
+            finals, stacked, checks = self._get_fn("padded", bucket)(
+                worlds, inp, st, starts, n_real
+            )
+        if pad:
+            finals, stacked, checks = self._trim_wave(
+                finals, stacked, checks, m, m_pad, bucket
+            )
+        return bucket, finals, stacked, checks
+
+    def _trim_wave(self, finals, stacked, checks, m, m_pad, bucket):
+        """Drop the padded lobby rows from a wave's outputs (ONE jitted
+        dispatch for the whole triple, compiled per (m, m_pad, bucket))."""
+        fn = self._trim_fns.get((m, m_pad, bucket))
+        if fn is None:
+
+            def body(fin, stk, chk):
+                fin = jax.tree.map(lambda a: a[:m], fin)
+                stk = jax.tree.map(lambda a: a[:m], stk)
+                chk = chk.reshape(m_pad, bucket, 2)[:m].reshape(-1, 2)
+                return fin, stk, chk
+
+            fn = self._trim_fns[(m, m_pad, bucket)] = jax.jit(body)
+        return fn(finals, stacked, checks)
+
+    def harvest_shards(self, outputs) -> dict:
+        """Block until a wave's outputs have retired on EVERY device and
+        report the per-shard layout: device count, lanes per device, and
+        per-device buffer residency.  This is the sharded bench stage's
+        per-device metrics probe — an allowlisted hot-loop purity flush
+        point (scripts/lint_imports.py): never call it from the steady-state
+        dispatch path."""
+        jax.block_until_ready(outputs)
+        leaves = jax.tree.leaves(outputs)
+        per_dev: Dict[str, int] = {}
+        for leaf in leaves:
+            shards = getattr(leaf, "addressable_shards", None)
+            if not shards:
+                continue
+            for s in shards:
+                key = str(s.device)
+                per_dev[key] = per_dev.get(key, 0) + 1
+        return {
+            "n_devices": self.n_devices,
+            "devices_touched": len(per_dev),
+            "buffers_per_device": per_dev,
+        }
+
+    def stats(self) -> dict:
+        """Parent counters plus ``shard_devices`` (mesh size)."""
+        out = super().stats()
+        out["shard_devices"] = self.n_devices
+        return out
+
+
+_pad_rows_jits: Dict[int, object] = {}
+
+
+def _pad_rows(tree, pad: int):
+    """Extend every leaf's leading (lobby) axis by ``pad`` rows repeating
+    row 0 (ONE jitted dispatch, compiled per pad count x tree shape).  The
+    pad lanes only ever run masked (``n_real = 0``) so their content is
+    irrelevant — repeating a real row keeps the arithmetic finite."""
+    fn = _pad_rows_jits.get(pad)
+    if fn is None:
+
+        def body(t):
+            return jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.broadcast_to(a[:1], (pad, *a.shape[1:]))]
+                ),
+                t,
+            )
+
+        fn = _pad_rows_jits[pad] = jax.jit(body)
+    return fn(tree)

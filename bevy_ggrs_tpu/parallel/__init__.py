@@ -1,8 +1,12 @@
 from . import multihost
 from .mesh import (
     DATA_AXIS,
+    LOBBY_AXIS,
     SPEC_AXIS,
+    lobby_sharding,
+    make_lobby_mesh,
     make_mesh,
+    shard_lobby_worlds,
     world_sharding,
     shard_world,
     make_sharded_resim_fn,
@@ -13,8 +17,12 @@ from .mesh import (
 __all__ = [
     "multihost",
     "DATA_AXIS",
+    "LOBBY_AXIS",
     "SPEC_AXIS",
+    "lobby_sharding",
+    "make_lobby_mesh",
     "make_mesh",
+    "shard_lobby_worlds",
     "world_sharding",
     "shard_world",
     "make_sharded_resim_fn",

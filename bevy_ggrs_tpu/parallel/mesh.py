@@ -30,6 +30,38 @@ from ..snapshot.world import WorldState
 
 DATA_AXIS = "data"
 SPEC_AXIS = "spec"
+LOBBY_AXIS = "lobby"
+
+
+def make_lobby_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D device mesh over the ``"lobby"`` axis — the many-worlds scale-out
+    shape (ops/batch.ShardedWaveExecutor): each device owns a contiguous
+    block of lobby lanes and runs the SAME bucketed wave program on them,
+    so a wave of M lobbies costs O(1) dispatches per device.
+
+    Orthogonal to :func:`make_mesh`: that mesh shards ONE world over its
+    entity axis; this one shards MANY whole worlds over the lobby axis
+    (no collectives at all — lobbies never communicate)."""
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices < 1:
+        raise ValueError(f"lobby mesh needs >= 1 device, got {n_devices}")
+    return Mesh(np.array(devices[:n_devices]), (LOBBY_AXIS,))
+
+
+def lobby_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """NamedSharding splitting the leading (lobby) axis over the mesh."""
+    return NamedSharding(mesh, P(LOBBY_AXIS, *([None] * (ndim - 1))))
+
+
+def shard_lobby_worlds(mesh: Mesh, worlds):
+    """Place a stacked ``[M, ...]`` many-worlds pytree onto the lobby mesh
+    (every leaf's leading axis split over ``"lobby"``; M must divide by the
+    device count — the BatchedRunner pads its resident world to ensure it)."""
+    return jax.device_put(
+        worlds, jax.tree.map(lambda a: lobby_sharding(mesh, a.ndim), worlds)
+    )
 
 
 def make_mesh(

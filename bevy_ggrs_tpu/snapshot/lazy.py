@@ -110,12 +110,27 @@ class BatchChecks:
 
         Called at dispatch time by the pipelined runner; by the time a
         session wants the value the transfer has usually landed and
-        :meth:`try_host` is a cached read.  No-op on objects without the
-        jax.Array async-copy surface (host-backed test stubs)."""
+        :meth:`try_host` is a cached read.  A batch whose checksums live
+        SHARDED across a device mesh (the lobby-sharded wave executor,
+        ops/batch.ShardedWaveExecutor) gets one non-blocking copy PER
+        SHARD — each device's block starts moving independently, and the
+        later harvest assembles the host array from the per-shard copies
+        without ever serializing the devices against each other.  No-op on
+        objects without the jax.Array async-copy surface (host-backed test
+        stubs)."""
         if self._host is not None or self._async:
             return
         if not _staged_copy_needed():
             # CPU: harvest gates on is_ready() alone; adoption is zero-copy
+            self._async = True
+            return
+        shards = self._shards()
+        if shards is not None:
+            # sharded checksums: one staged copy per device shard
+            for s in shards:
+                copy = getattr(s.data, "copy_to_host_async", None)
+                if copy is not None:
+                    copy()
             self._async = True
             return
         copy = getattr(self._dev, "copy_to_host_async", None)
@@ -123,8 +138,24 @@ class BatchChecks:
             copy()
             self._async = True
 
+    def _shards(self):
+        """The batch's addressable device shards when it is split across a
+        mesh (>= 2 shards), else None (the single-device fast path)."""
+        shards = getattr(self._dev, "addressable_shards", None)
+        if shards is not None and len(shards) > 1:
+            return shards
+        return None
+
     def _transfer_landed(self) -> bool:
-        """True when reading the device value would not block."""
+        """True when reading the device value would not block (for a
+        sharded batch: every shard's copy has landed)."""
+        shards = self._shards()
+        if shards is not None:
+            for s in shards:
+                ready = getattr(s.data, "is_ready", None)
+                if ready is not None and not ready():
+                    return False
+            return True
         ready = getattr(self._dev, "is_ready", None)
         return bool(ready()) if ready is not None else True
 

@@ -22,4 +22,14 @@ def apply_platform_env() -> None:
     if platform:
         jax.config.update("jax_platforms", platform)
     if ndev:
-        jax.config.update("jax_num_cpu_devices", int(ndev))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(ndev))
+        except AttributeError:
+            # jax < 0.5 has no jax_num_cpu_devices; the XLA flag is the
+            # portable spelling, read at backend init (first device use),
+            # so it still applies as long as no device has been queried
+            flag = "--xla_force_host_platform_device_count"
+            if flag not in os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") + f" {flag}={int(ndev)}"
+                ).strip()

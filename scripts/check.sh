@@ -29,7 +29,9 @@ g.dryrun_multichip(8)
 print("graft ok")
 EOF
 
-echo "== bench smoke (batched stage, O(1)-dispatch gate) =="
+echo "== bench smoke (batched + sharded stages, O(1)-dispatch gates) =="
+# the sharded stage runs under forced 8-virtual-device CPU and hard-fails
+# unless per-device dispatches per tick are flat across lobby counts
 python bench.py --smoke
 
 echo "== bench =="

@@ -40,6 +40,10 @@ PURITY_ALLOW = {
         "lobby_checksum",         # user-facing flush point
         "finish",                 # end-of-run flush
     },
+    "bevy_ggrs_tpu/ops/batch.py": {
+        "harvest_shards",         # per-device metrics probe (bench/dryrun
+                                  # only — never called from the tick path)
+    },
     "bevy_ggrs_tpu/session/p2p.py": {
         "check_now",              # finish()/set_session flush hook
         "_resolve_checksum",      # the one sanctioned force/peek funnel

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Multichip dry-run harness: run ``dryrun_multichip`` and write a
+MULTICHIP-style JSON record with real per-device metrics.
+
+Historically the MULTICHIP_r*.json records were written by a driver that
+captured ``__graft_entry__.dryrun_multichip`` output — which printed
+nothing, so every record carried ``"tail": ""`` yet still said
+``"ok": true``.  An empty tail is indistinguishable from a run that did
+nothing, so this harness enforces the honest rule:
+
+    empty output  ->  {"ok": false, "skipped": true}   (NEVER ok)
+
+``_dryrun_payload`` now prints one ``MULTICHIP_METRICS {json}`` line per
+sharded program (canonical mesh + lobby-sharded wave executor, each with
+per-device buffer residency); those lines are parsed out of the tail into
+a structured ``metrics`` list.
+
+Usage:
+    python scripts/multichip_bench.py [--n-devices 8] [--out MULTICHIP.json]
+
+Exit code 0 when the record is ok OR honestly skipped; 1 on rc != 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+METRICS_PREFIX = "MULTICHIP_METRICS "
+
+
+def classify(rc: int, tail: str) -> dict:
+    """The empty-tail rule, factored for unit testing: a record may be
+    ``ok`` only when the run exited 0 AND produced output.  rc==0 with an
+    empty tail means the run cannot prove it measured anything — mark it
+    ``skipped``, never ``ok``."""
+    has_output = bool(tail.strip())
+    return {
+        "rc": rc,
+        "ok": rc == 0 and has_output,
+        "skipped": rc == 0 and not has_output,
+    }
+
+
+def parse_metrics(tail: str) -> list:
+    """Extract the structured MULTICHIP_METRICS lines from captured output
+    (non-metrics lines stay in the tail verbatim)."""
+    out = []
+    for line in tail.splitlines():
+        if line.startswith(METRICS_PREFIX):
+            try:
+                out.append(json.loads(line[len(METRICS_PREFIX):]))
+            except json.JSONDecodeError:
+                pass  # a torn line is tail noise, not a harness failure
+    return out
+
+
+def run(n_devices: int, timeout_s: int) -> dict:
+    code = (
+        "import __graft_entry__; "
+        f"__graft_entry__.dryrun_multichip({n_devices})"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=ROOT, capture_output=True, text=True, timeout=timeout_s,
+        )
+        rc, tail = r.returncode, (r.stdout + r.stderr)[-4000:]
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        tail = ((e.stdout or b"").decode(errors="replace")
+                + (e.stderr or b"").decode(errors="replace"))[-4000:]
+        tail += "\n[multichip_bench: TIMEOUT]"
+    record = {"n_devices": n_devices, **classify(rc, tail), "tail": tail}
+    record["metrics"] = parse_metrics(tail)
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-devices", type=int, default=8)
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--out", default=None,
+                    help="write the JSON record here (default: stdout only)")
+    args = ap.parse_args()
+    record = run(args.n_devices, args.timeout)
+    text = json.dumps(record, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0 if record["rc"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

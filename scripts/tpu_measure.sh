@@ -38,6 +38,11 @@ run "speculation payoff (lossy/jittery P2P)" 1200 \
 
 run "cross-backend checksum parity" 300 python scripts/parity_check.py
 
+# writes the MULTICHIP record itself (empty-output runs are marked
+# "skipped", never "ok" — see scripts/multichip_bench.py)
+run "multichip dry run (8 devices)" 1000 \
+  python scripts/multichip_bench.py --n-devices 8 --out MULTICHIP.json
+
 run "program-variant stability" 600 python - <<'PYEOF'
 from bevy_ggrs_tpu.ops.variant_probe import probe_program_variants
 from bevy_ggrs_tpu.models import box_game, pong, crowd, stress, fixed_point

@@ -25,3 +25,26 @@ try:
     jax.config.update("jax_num_cpu_devices", 8)
 except AttributeError:
     pass  # older jax: the XLA_FLAGS fallback above covers it
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    """The 8-virtual-device CPU mesh, verified — for the lobby-sharding
+    tests (tests/test_sharded_wave.py), which are meaningless on fewer
+    devices.  The XLA flag above only takes effect when it precedes backend
+    init; if some earlier import already initialized a smaller backend
+    (e.g. an ambient single-chip TPU sitecustomize), SKIP the module rather
+    than fail it."""
+    flag = "--xla_force_host_platform_device_count"
+    assert flag in os.environ.get("XLA_FLAGS", ""), (
+        "conftest did not force the XLA host device count"
+    )
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip(
+            f"backend initialized with {len(devices)} device(s); the XLA "
+            "device-count flag was applied too late to provision 8"
+        )
+    return devices[:8]
