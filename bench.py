@@ -774,6 +774,21 @@ def stage_pipeline():
     for r in (*sync_runners, *pipe_runners):
         r.finish()
 
+    # tick-phase reconciliation over the pipelined arm: the phase timers'
+    # cumulative attribution must cover the wall tick time (a phase missing
+    # from the catalog would show up here as unattributed residual)
+    phase_tot = {}
+    phase_ticks = 0
+    wall_s = unattr_s = 0.0
+    for r in pipe_runners:
+        t = r.stats()["phases"]
+        phase_ticks += t["ticks"]
+        wall_s += t["wall_seconds"]
+        unattr_s += t["unattributed_seconds"]
+        for k, v in t["phase_seconds"].items():
+            phase_tot[k] = phase_tot.get(k, 0.0) + v
+    unattr_pct = round(100.0 * unattr_s / wall_s, 2) if wall_s else 0.0
+
     agg_sync, _, spread_sync_raw = _trimmed_mean_spread(sync_tps)
     agg_pipe, spread_pipe, spread_pipe_raw = _trimmed_mean_spread(pipe_tps)
     ratios = [p / s for p, s in zip(pipe_tps, sync_tps)]
@@ -796,6 +811,12 @@ def stage_pipeline():
             f"{PIPELINE_MIN_SPEEDUP} on cpu "
             f"(sync {agg_sync:.1f} vs pipelined {agg_pipe:.1f} ticks/s)"
         )
+    if phase_ticks and unattr_pct > 10.0:
+        raise RuntimeError(
+            f"pipeline gate: {unattr_pct}% of wall tick time is not "
+            "attributed to any phase timer (required: <= 10%) — a hot-loop "
+            "phase is missing from the telemetry.phases catalog"
+        )
     return {
         "pipeline_ticks_per_sec_sync": round(agg_sync, 1),
         "pipeline_ticks_per_sec_pipelined": round(agg_pipe, 1),
@@ -808,6 +829,10 @@ def stage_pipeline():
         "pipeline_sync_forced": forced_sync,
         "pipeline_sync_blocked_seconds": round(blocked_sync, 4),
         "pipeline_degrades": degrades,
+        "pipeline_phase_ms": {
+            k: round(v * 1e3, 1) for k, v in phase_tot.items()
+        },
+        "pipeline_unattributed_pct": unattr_pct,
         "pipeline_entities": PIPELINE_ENTITIES,
         "pipeline_rep_policy": (
             f"paired alternating {PIPELINE_SLICE}-tick slices x "

@@ -295,6 +295,9 @@ class BatchedRunner:
         else:
             self._load_transform = self.app.reg.load_state
             self._store_transform = self.app.reg.store_state
+        # tick-phase latency attribution (flight recorder + tick_phase_ms
+        # histograms — docs/observability.md "Phase timers")
+        self._phases = telemetry.PhaseSet(owner="batched")
         # pre-bound argument-free counters: name+help registered ONCE here,
         # per-tick increments are attribute checks (not dict/string traffic)
         _treg = telemetry.registry()
@@ -327,10 +330,13 @@ class BatchedRunner:
         """One server tick: poll + step every lobby, flush as waves."""
         self.ticks += 1
         self._m_ticks.inc()
+        ph = self._phases
+        ph.begin_tick()
         if self.pipeline:
             # harvest last tick's landed checksum copies before the lobby
             # polls publish them (never blocks)
-            self._rbq.harvest()
+            with ph.phase("readback_harvest"):
+                self._rbq.harvest()
         per_lobby_ops: List[List[_Op]] = []
         for b, s in enumerate(self.sessions):
             per_lobby_ops.append(self._collect_ops(b, s))
@@ -345,32 +351,38 @@ class BatchedRunner:
             cf = s.confirmed_frame()
             self.confirmed[b] = cf
             self.rings[b].confirm(cf)
+        if n_waves:
+            # handshake-only ticks (no lobby emitted an op) stay out of the
+            # flight ring — they would evict the interesting entries
+            ph.end_tick(frame=max(self.frames), lobbies=len(self.sessions))
 
     def _collect_ops(self, b: int, s) -> List[_Op]:
-        if hasattr(s, "poll_remote_clients"):
-            s.poll_remote_clients()
-        if hasattr(s, "events") and (
-            self.on_event is not None or telemetry.enabled()
-        ):
-            for ev in s.events():
-                if isinstance(ev, DesyncDetected):
-                    telemetry.record(
-                        "checksum_mismatch", source="p2p", lobby=b,
-                        frames=[ev.frame], local_checksum=ev.local_checksum,
-                        remote_checksum=ev.remote_checksum, addr=repr(ev.addr),
-                    )
-                    if telemetry.forensics_dir() is not None:
-                        # lobby_world is a device gather — only pay it when
-                        # a report will actually be written
-                        telemetry.write_desync_report(
-                            "p2p_desync", reg=self.app.reg,
-                            world=self.lobby_world(b), frames=[ev.frame],
-                            local_checksum=ev.local_checksum,
-                            remote_checksum=ev.remote_checksum, addr=ev.addr,
-                            lobby=b,
+        with self._phases.phase("net_poll"):
+            if hasattr(s, "poll_remote_clients"):
+                s.poll_remote_clients()
+            if hasattr(s, "events") and (
+                self.on_event is not None or telemetry.enabled()
+            ):
+                for ev in s.events():
+                    if isinstance(ev, DesyncDetected):
+                        telemetry.record(
+                            "checksum_mismatch", source="p2p", lobby=b,
+                            frames=[ev.frame], local_checksum=ev.local_checksum,
+                            remote_checksum=ev.remote_checksum,
+                            addr=repr(ev.addr),
                         )
-                if self.on_event is not None:
-                    self.on_event(b, ev)
+                        if telemetry.forensics_dir() is not None:
+                            # lobby_world is a device gather — only pay it
+                            # when a report will actually be written
+                            telemetry.write_desync_report(
+                                "p2p_desync", reg=self.app.reg,
+                                world=self.lobby_world(b), frames=[ev.frame],
+                                local_checksum=ev.local_checksum,
+                                remote_checksum=ev.remote_checksum,
+                                addr=ev.addr, lobby=b,
+                            )
+                    if self.on_event is not None:
+                        self.on_event(b, ev)
         if isinstance(s, SyncTestSession):
             handles = list(range(s.num_players()))
         else:
@@ -380,7 +392,7 @@ class BatchedRunner:
         for h, v in self.read_inputs(b, handles).items():
             s.add_local_input(h, v)
         try:
-            with span("SessionAdvanceFrame"):
+            with self._phases.phase("session_step"), span("SessionAdvanceFrame"):
                 requests = s.advance_frame()
         except MismatchedChecksumError as e:
             self._report_mismatch(b, e)
@@ -412,6 +424,8 @@ class BatchedRunner:
         if not loads:
             return
         self.rollbacks += len(loads)
+        for b, f in loads:
+            self._phases.note_rollback(self.frames[b] - f)
         if telemetry.enabled():
             for b, f in loads:
                 telemetry.count("rollbacks_total", lobby=b)
@@ -421,7 +435,7 @@ class BatchedRunner:
                 telemetry.record("rollback", lobby=b, to_frame=f,
                                  from_frame=self.frames[b],
                                  depth=self.frames[b] - f)
-        with span("LoadWorldBatched"):
+        with self._phases.phase("rollback_load"), span("LoadWorldBatched"):
             # batched mixed-source load: roll every ring back, group the
             # stored LazySlice handles by backing stacked buffer, and serve
             # the whole wave — even when lobbies load from DIFFERENT past
@@ -477,26 +491,29 @@ class BatchedRunner:
         bucket = 0
         pre_checksum = list(self._world_checksum)
         prev_worlds = self.worlds
+        ph = self._phases
         if k_hot > 0:
+            ph.note_advances(sum(ks))
             bucket = self.exec.bucket_for(k_hot)
             # persistent staging fill (no per-tick allocation): write each
             # lobby's rows in place, repeat the last real row through the
             # bucket tail (padding inputs never affect results — masked by
             # n_real — but keeping them finite avoids garbage-driven traps)
-            inputs, status = self._stage_inputs, self._stage_status
-            starts = self._stage_starts
-            starts[:m] = self.frames  # pad lanes (sharded mode) keep 0
-            for b, a in enumerate(adv):
-                kb = len(a)
-                if not kb:
-                    continue
-                bi, bs = inputs[b], status[b]
-                for i, x in enumerate(a):
-                    bi[i] = x.inputs
-                    bs[i] = x.status
-                if kb < bucket:
-                    bi[kb:bucket] = bi[kb - 1]
-                    bs[kb:bucket] = bs[kb - 1]
+            with ph.phase("stage_inputs"):
+                inputs, status = self._stage_inputs, self._stage_status
+                starts = self._stage_starts
+                starts[:m] = self.frames  # pad lanes (sharded mode) keep 0
+                for b, a in enumerate(adv):
+                    kb = len(a)
+                    if not kb:
+                        continue
+                    bi, bs = inputs[b], status[b]
+                    for i, x in enumerate(a):
+                        bi[i] = x.inputs
+                        bs[i] = x.status
+                    if kb < bucket:
+                        bi[kb:bucket] = bi[kb - 1]
+                        bs[kb:bucket] = bs[kb - 1]
             self.device_dispatches += 1
             self._m_dispatches.inc()
             self._m_resim_frames.inc(sum(max(k - 1, 0) for k in ks))
@@ -513,7 +530,7 @@ class BatchedRunner:
             if self.planner is not None:
                 self.planner.plan(ks)
                 wave_ks = ks + [0] * (self._m_pad - m)
-            with span("AdvanceWorldBatched"):
+            with ph.phase("wave_dispatch"), span("AdvanceWorldBatched"):
                 bucket, finals, stacked, checks_flat = self.exec.run_wave(
                     self.worlds, inputs, status, starts, wave_ks
                 )
@@ -527,7 +544,7 @@ class BatchedRunner:
                         self._world_checksum[b] = batch.ref(
                             b * bucket + ks[b] - 1
                         )
-        with span("SaveWorldBatched"):
+        with ph.phase("store_save"), span("SaveWorldBatched"):
             # collect this wave's saves as (lobby, advance-count-before, req)
             saves = []
             for b, run in enumerate(runs):
@@ -607,6 +624,7 @@ class BatchedRunner:
             "stalled_frames": list(self.stalled),
             "frames": list(self.frames),
             "confirmed": list(self.confirmed),
+            "phases": self._phases.totals(),
         }
         if self.planner is not None:
             out["sharded"] = {

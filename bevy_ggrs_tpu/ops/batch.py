@@ -27,7 +27,8 @@ from lane-stacking tiny elementwise work; use per-lobby dispatches there.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import time
+from typing import Dict, List, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -244,6 +245,12 @@ class BucketedWaveExecutor:
         self.compile_count = 0  # programs built (per (kind, bucket))
         self.dispatch_count = 0
         self.bucket_hist: Dict[int, int] = {b: 0 for b in self.buckets}
+        # first-dispatch wall time per program variant: jit compiles lazily,
+        # so the first call of each (kind, bucket) pays trace+compile — the
+        # device-time attribution bench/stats surface (keys "exact_k4", ...)
+        self.compile_ms: Dict[str, float] = {}
+        self._timed: Set[Tuple[str, int]] = set()
+        self._owner = "wave"
         from .. import telemetry
 
         _reg = telemetry.registry()
@@ -290,6 +297,37 @@ class BucketedWaveExecutor:
             self._m_compiles.inc()
         return fn
 
+    def _dispatch(self, kind: str, bucket: int, *args):
+        """Call the ``(kind, bucket)`` wave program, timing its FIRST call.
+
+        jit returns instantly at build time and compiles at first dispatch,
+        so that call's wall time IS the program's trace+compile cost; it
+        lands in :attr:`compile_ms`, the flight recorder and (telemetry on)
+        the ``program_compile_ms`` histogram.  Steady-state overhead over a
+        raw ``_get_fn(...)(...)`` call: one extra set lookup."""
+        key = (kind, bucket)
+        if key in self._timed:
+            return self._fns[key](*args)
+        fn = self._get_fn(kind, bucket)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        ms = (time.perf_counter() - t0) * 1e3
+        self._timed.add(key)
+        self.compile_ms[f"{kind}_k{bucket}"] = round(ms, 3)
+        from .. import telemetry
+
+        telemetry.flight_recorder().record(
+            "compile", owner=self._owner, program=kind, k=bucket,
+            ms=round(ms, 3),
+        )
+        telemetry.observe(
+            "program_compile_ms", ms,
+            "wall ms of each program variant's first dispatch (trace+compile)",
+            buckets=telemetry.LATENCY_MS_BUCKETS,
+            owner=self._owner, kind=kind,
+        )
+        return out
+
     def run_wave(self, worlds, inputs, status, starts, ks):
         """Dispatch one wave; returns ``(bucket, finals, stacked,
         checks_flat)``.
@@ -315,22 +353,22 @@ class BucketedWaveExecutor:
                 prev = self._prev_out.pop(key, None)
                 if prev is None:
                     # first call at this bucket: nothing to recycle yet
-                    finals, stacked, checks = self._get_fn("exact", bucket)(
-                        worlds, inp, st, starts
+                    finals, stacked, checks = self._dispatch(
+                        "exact", bucket, worlds, inp, st, starts
                     )
                 else:
-                    finals, stacked, checks = self._get_fn(*key)(
-                        worlds, inp, st, starts, *prev
+                    finals, stacked, checks = self._dispatch(
+                        *key, worlds, inp, st, starts, *prev
                     )
                 self._prev_out[key] = (stacked, checks)
             else:
-                finals, stacked, checks = self._get_fn("exact", bucket)(
-                    worlds, inp, st, starts
+                finals, stacked, checks = self._dispatch(
+                    "exact", bucket, worlds, inp, st, starts
                 )
         else:
             n_real = np.asarray(ks, np.int32)
-            finals, stacked, checks = self._get_fn("padded", bucket)(
-                worlds, inp, st, starts, n_real
+            finals, stacked, checks = self._dispatch(
+                "padded", bucket, worlds, inp, st, starts, n_real
             )
         return bucket, finals, stacked, checks
 
@@ -348,6 +386,7 @@ class BucketedWaveExecutor:
             "program_compiles": self.compile_count,
             "bucket_hist": {k: v for k, v in self.bucket_hist.items() if v},
             "jit_entries": jit_entries,
+            "compile_ms": dict(self.compile_ms),
         }
 
 
@@ -492,6 +531,7 @@ class ShardedWaveExecutor(BucketedWaveExecutor):
                          fused_checksums=fused_checksums)
         self.mesh = mesh
         self.n_devices = int(mesh.devices.size)
+        self._owner = "sharded"
         from .. import telemetry
 
         _reg = telemetry.registry()
@@ -566,13 +606,13 @@ class ShardedWaveExecutor(BucketedWaveExecutor):
         self._m_dispatches.inc()
         self._m_sharded_dispatches.inc()
         if exact:
-            finals, stacked, checks = self._get_fn("exact", bucket)(
-                worlds, inp, st, starts
+            finals, stacked, checks = self._dispatch(
+                "exact", bucket, worlds, inp, st, starts
             )
         else:
             n_real = np.asarray(ks, np.int32)
-            finals, stacked, checks = self._get_fn("padded", bucket)(
-                worlds, inp, st, starts, n_real
+            finals, stacked, checks = self._dispatch(
+                "padded", bucket, worlds, inp, st, starts, n_real
             )
         if pad:
             finals, stacked, checks = self._trim_wave(

@@ -14,6 +14,7 @@ device->host syncs only happen when the protocol needs the value."""
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -168,6 +169,14 @@ class GgrsRunner:
         # (shapes are static per app), so compute once per depth instead of
         # walking the pytree every tick
         self._stacked_bytes_by_k: dict = {}
+        # Tick-phase attribution (telemetry/phases.py): guarded timers per
+        # hot-loop phase feeding the always-on flight recorder and — while
+        # telemetry is enabled — the tick_phase_ms histograms.  compile_ms
+        # keeps first-dispatch wall time per program variant (the trace+
+        # compile cost of the make_*_fn-built programs, paid at first call).
+        self._phases = telemetry.PhaseSet(owner="solo")
+        self.compile_ms: Dict[str, float] = {}
+        self._seen_variants: set = set()
         if session is not None:
             self.set_session(session)
 
@@ -312,25 +321,32 @@ class GgrsRunner:
         if self.session is None:
             self.accumulator = 0.0
             return
+        ph = self._phases
+        ph.begin_tick()
         if self.pipeline:
             # collect last tick's landed checksum copies BEFORE the network
             # poll, so the session's desync driver publishes them this tick
             # without ever blocking on the device
-            self._rbq.harvest()
+            with ph.phase("readback_harvest"):
+                self._rbq.harvest()
         if hasattr(self.session, "poll_remote_clients"):
-            with span("PollRemoteClients"):
-                self.session.poll_remote_clients()
-            self._drain_events()
-            if telemetry.enabled():
-                self._record_network_stats()
+            with ph.phase("net_poll"):
+                with span("PollRemoteClients"):
+                    self.session.poll_remote_clients()
+                self._drain_events()
+                if telemetry.enabled():
+                    self._record_network_stats()
         pending: List[GgrsRequest] = []
         pending_ticks = 0
         ran_requests = False
+        stepped = 0
         while self.accumulator >= fps_delta:
             self.accumulator -= fps_delta
+            stepped += 1
             if hasattr(self.session, "frames_ahead"):
                 self.run_slow = self.session.frames_ahead() > 0
-            reqs = self._step_session()
+            with ph.phase("session_step"):
+                reqs = self._step_session()
             if reqs:
                 pending.extend(reqs)
                 pending_ticks += 1
@@ -347,7 +363,12 @@ class GgrsRunner:
             # synchronous mode: zero-deep in-flight window — retire this
             # tick's device work (world + checksum readback) before the
             # driver returns, exactly the behavior pipelining replaces
-            self._drain_inflight()
+            with ph.phase("readback_harvest"):
+                self._drain_inflight()
+        if stepped:
+            # idle accumulator polls (sub-frame deltas, handshake spins)
+            # don't flood the flight ring with empty entries
+            ph.end_tick(frame=self.frame)
 
     @property
     def checksum(self) -> int:
@@ -424,6 +445,8 @@ class GgrsRunner:
             "confirmed": self.confirmed,
             "pipeline": self.pipeline,
             "pipeline_degrades": self.pipeline_degrades,
+            "phases": self._phases.totals(),
+            "compile_ms": dict(self.compile_ms),
         }
 
     def tick(self) -> None:
@@ -619,6 +642,7 @@ class GgrsRunner:
         """LoadGameState: restore the ring snapshot for ``frame``
         (schedule_systems.rs:238-249)."""
         self.rollbacks += 1
+        self._phases.note_rollback(self.frame - frame)
         telemetry.count("rollbacks_total", help="LoadRequests executed")
         telemetry.observe(
             "rollback_depth", self.frame - frame,
@@ -626,7 +650,7 @@ class GgrsRunner:
         )
         telemetry.record("rollback", to_frame=frame, from_frame=self.frame,
                          depth=self.frame - frame)
-        with span("LoadWorld"):
+        with self._phases.phase("rollback_load"), span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
             was_lazy = isinstance(stored, LazySlice)
             if (
@@ -699,6 +723,8 @@ class GgrsRunner:
         transition fans out candidate branches for the next tick."""
         adv = [r for r in run if isinstance(r, AdvanceRequest)]
         k = len(adv)
+        ph = self._phases
+        ph.note_advances(k)
         identity = self.app.reg.is_identity_strategy()
         if not hasattr(self._world_checksum, "to_int"):
             # tolerate external writes of a bare uint32[2] device checksum
@@ -783,27 +809,38 @@ class GgrsRunner:
                     "donated_dispatches_total", help="dispatches donating the input world"
                 )
             with span("AdvanceWorld"):
-                inputs, status = self._stage_rows(adv[skip:])
-                if use_branched:
-                    final, stacked, checks = self._dispatch_branched(
-                        inputs, status, adv[-1]
-                    )
-                else:
-                    fn = (
-                        self.app.resim_fn_donated if donate
-                        else self.app.resim_fn
-                    )
-                    if donate:
-                        self.donated_dispatches += 1
-                    final, stacked, checks = fn(
-                        self.world, inputs, status, self.frame
-                    )
-                batch_checks = BatchChecks(checks)
-                if self.pipeline:
-                    # ahead-of-tick readback: the device->host checksum copy
-                    # rides behind the dispatch; harvest() collects it next
-                    # tick while the device runs frame N+1
-                    self._rbq.start(batch_checks)
+                with ph.phase("stage_inputs"):
+                    inputs, status = self._stage_rows(adv[skip:])
+                variant = (
+                    "branched" if use_branched
+                    else ("donated" if donate else "plain"),
+                    k - skip,
+                )
+                fresh = variant not in self._seen_variants
+                t_build = time.perf_counter() if fresh else 0.0
+                with ph.phase("wave_dispatch"):
+                    if use_branched:
+                        final, stacked, checks = self._dispatch_branched(
+                            inputs, status, adv[-1]
+                        )
+                    else:
+                        fn = (
+                            self.app.resim_fn_donated if donate
+                            else self.app.resim_fn
+                        )
+                        if donate:
+                            self.donated_dispatches += 1
+                        final, stacked, checks = fn(
+                            self.world, inputs, status, self.frame
+                        )
+                    batch_checks = BatchChecks(checks)
+                    if self.pipeline:
+                        # ahead-of-tick readback: the device->host checksum
+                        # copy rides behind the dispatch; harvest() collects
+                        # it next tick while the device runs frame N+1
+                        self._rbq.start(batch_checks)
+                if fresh:
+                    self._note_compile(variant, time.perf_counter() - t_build)
                 if self.spec_cache is not None and k - skip >= 2:
                     last_adv_src = slice_frame(stacked, k - skip - 2)
                 self.world = final
@@ -831,7 +868,7 @@ class GgrsRunner:
                 donated=donate, save_bytes=stacked_bytes,
             )
         pushed_pre_world = False
-        with span("SaveWorld"):
+        with ph.phase("store_save"), span("SaveWorld"):
             c = 0  # advances seen so far within the run
             for r in run:
                 if isinstance(r, AdvanceRequest):
@@ -894,6 +931,26 @@ class GgrsRunner:
             self.spec_cache.speculate(
                 last_adv_src, frame_add(self.frame, -1), adv[-1].inputs
             )
+
+    def _note_compile(self, variant, dt: float) -> None:
+        """Record a program variant's first-dispatch wall time (trace +
+        compile dominate the first call of each ``(kind, depth)`` jit
+        variant — later calls hit the executable cache), into
+        :attr:`compile_ms`, the flight recorder and (when telemetry is on)
+        the ``program_compile_ms`` histogram."""
+        kind, depth = variant
+        self._seen_variants.add(variant)
+        ms = dt * 1e3
+        self.compile_ms[f"{kind}_k{depth}"] = round(ms, 3)
+        telemetry.flight_recorder().record(
+            "compile", owner="solo", program=kind, k=depth, ms=round(ms, 3)
+        )
+        telemetry.observe(
+            "program_compile_ms", ms,
+            "wall ms of each program variant's first dispatch (trace+compile)",
+            buckets=telemetry.LATENCY_MS_BUCKETS,
+            owner="solo", kind=kind,
+        )
 
     def _dispatch_branched(self, inputs, status, last_adv):
         """One canonical [B, K] dispatch: lane 0 = the real batch; hedge

@@ -85,6 +85,12 @@ def _note_readback(harvested: int = 0, forced: int = 0,
         telemetry.count("readback_forced_total", forced,
                         help="checksum readbacks that blocked the host "
                              "(flush points / sync mode)")
+        # always-on black box: forced pulls are the pipeline's degrade
+        # signal, so they earn a flight-ring entry even with telemetry off
+        telemetry.flight_recorder().record(
+            "forced_readback", n=forced,
+            blocked_ms=round(blocked_s * 1e3, 3),
+        )
     if blocked_s:
         telemetry.count("host_blocked_seconds", blocked_s,
                         help="host seconds spent blocked in device->host "

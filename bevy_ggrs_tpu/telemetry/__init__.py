@@ -22,7 +22,13 @@ from __future__ import annotations
 
 import os
 
-from .forensics import (  # noqa: F401 (public re-exports)
+from .flight import (  # noqa: F401 (public re-exports)
+    FlightRecorder,
+    configure as configure_flight,
+    dump_flight_record,
+    flight_recorder,
+)
+from .forensics import (  # noqa: F401
     component_checksums,
     configure as configure_forensics,
     forensics_dir,
@@ -30,13 +36,21 @@ from .forensics import (  # noqa: F401 (public re-exports)
 )
 from .metrics import (  # noqa: F401
     FRAME_BUCKETS,
+    LATENCY_MS_BUCKETS,
     MS_BUCKETS,
     BoundMetric,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
+    percentile_from_buckets,
     registry,
+)
+from .phases import (  # noqa: F401
+    PHASES,
+    PhaseSet,
+    format_phase_table,
+    phase_breakdown,
 )
 from .prometheus import MetricsExporter, start_http_exporter  # noqa: F401
 from .timeline import (  # noqa: F401
@@ -50,12 +64,15 @@ from .timeline import (  # noqa: F401
 __all__ = [
     "BoundMetric",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsExporter",
-    "Timeline", "FRAME_BUCKETS", "MS_BUCKETS",
+    "Timeline", "FRAME_BUCKETS", "MS_BUCKETS", "LATENCY_MS_BUCKETS",
+    "PHASES", "PhaseSet", "FlightRecorder",
+    "phase_breakdown", "format_phase_table",
     "enable", "disable", "enabled", "reset", "summary",
     "registry", "timeline", "record", "export_jsonl", "span_sink",
-    "count", "observe", "gauge_set",
+    "count", "observe", "gauge_set", "percentile_from_buckets",
     "component_checksums", "configure_forensics", "forensics_dir",
     "write_desync_report", "start_http_exporter",
+    "flight_recorder", "configure_flight", "dump_flight_record",
 ]
 
 
@@ -81,9 +98,11 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Drop all recorded metrics and timeline events (test isolation)."""
+    """Drop all recorded metrics, timeline events and flight-recorder
+    entries (test isolation)."""
     registry().reset()
     timeline().clear()
+    flight_recorder().clear()
 
 
 def count(name: str, n: float = 1, help: str = "", **labels) -> None:
@@ -107,11 +126,40 @@ def gauge_set(name: str, v: float, help: str = "", **labels) -> None:
         reg.gauge(name, help).set(v, **labels)
 
 
+def _latency_percentiles(reg) -> dict:
+    """p50/p95/p99 per series of the tick-latency histogram families
+    (``tick_phase_ms`` / ``tick_wall_ms`` / ``tick_unattributed_ms``),
+    estimated from their cumulative log-spaced buckets.  Keys are the
+    series label strings (e.g. ``owner=solo,phase=wave_dispatch``)."""
+    out = {}
+    for m in reg.metrics():
+        if m.kind != "histogram" or m.name not in (
+            "tick_phase_ms", "tick_wall_ms", "tick_unattributed_ms",
+            "program_compile_ms",
+        ):
+            continue
+        fam = {}
+        for key, series in m.series().items():
+            skey = ",".join(f"{k}={v}" for k, v in key)
+            fam[skey] = {
+                f"p{q * 100:g}": round(
+                    percentile_from_buckets(m.buckets, series, q), 4
+                )
+                for q in (0.5, 0.95, 0.99)
+            }
+            fam[skey]["count"] = series["count"]
+        if fam:
+            out[m.name] = fam
+    return out
+
+
 def summary() -> dict:
     """One merged dict of everything: the ``bench.py`` BENCH payload.
 
-    Includes derived ratios (``speculation_hit_ratio``) computed from the
-    raw counters so consumers need no metric arithmetic."""
+    Includes derived ratios (``speculation_hit_ratio``) and per-phase
+    latency percentiles (``latency_ms`` — p50/p95/p99 per
+    ``tick_phase_ms`` series) computed from the raw metrics so consumers
+    need no metric arithmetic."""
     reg = registry()
     snap = reg.snapshot()
 
@@ -138,8 +186,11 @@ def summary() -> dict:
             "readback_forced_total": _total("readback_forced_total"),
             "host_blocked_seconds": _total("host_blocked_seconds"),
             "pipeline_degrade_total": _total("pipeline_degrade_total"),
+            "latency_ms": _latency_percentiles(reg),
         },
         "timeline_events": len(timeline()),
+        "timeline_events_dropped": timeline().dropped,
+        "flight_record_entries": len(flight_recorder()),
     }
 
 

@@ -20,6 +20,7 @@ import os
 import time
 from typing import Optional
 
+from . import flight as _flight
 from . import metrics as _metrics
 from . import timeline as _timeline
 
@@ -116,6 +117,10 @@ def write_desync_report(
             else None
         ),
         "timeline_tail": _timeline.timeline().tail(_STATE["timeline_tail"]),
+        # always-on black box: the last-N-ticks phase breakdowns and
+        # rollback decisions are present even when telemetry was never
+        # enabled (docs/observability.md "Flight recorder")
+        "flight_record": _flight.flight_recorder().snapshot(),
         "metrics": _metrics.registry().snapshot(),
     }
     with open(path, "w") as f:

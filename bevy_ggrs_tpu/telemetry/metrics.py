@@ -26,6 +26,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 # frames (rollback depth, input latency — small ints) and milliseconds
 FRAME_BUCKETS = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0)
 MS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0)
+# fixed log-spaced latency buckets (1-2-5 per decade, 5us .. 1s) — the
+# tick-phase timers' family: wide enough that one set covers a sub-ms CPU
+# staging phase and a 100ms+ cold-compile dispatch without re-bucketing
+LATENCY_MS_BUCKETS = (
+    0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0, 1000.0,
+)
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -110,7 +117,14 @@ class Histogram(_Metric):
         """Record one observation of ``v``."""
         if not self._reg.enabled:
             return
-        key = _label_key(labels)
+        self.observe_key(_label_key(labels), v)
+
+    def observe_key(self, key: LabelKey, v: float) -> None:
+        """Observe with a pre-resolved label key — the hot-path variant:
+        callers that observe the same series every tick (the phase timers)
+        build the key once instead of sorting a label dict per call."""
+        if not self._reg.enabled:
+            return
         with self._reg._lock:
             s = self._series.get(key)
             if s is None:
@@ -129,6 +143,26 @@ class Histogram(_Metric):
         if s is None:
             return None
         return {"buckets": list(s["buckets"]), "sum": s["sum"], "count": s["count"]}
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        """Estimate the ``q``-quantile (0 < q <= 1) of one series from its
+        cumulative bucket counts — linear interpolation inside the covering
+        bucket (the ``histogram_quantile`` estimator).  Observations past the
+        last finite bound clamp to it, exactly like Prometheus; returns None
+        for an empty/absent series."""
+        s = self.snapshot(**labels)
+        return percentile_from_buckets(self.buckets, s, q) if s else None
+
+    def percentiles(self, qs=(0.5, 0.95, 0.99), **labels) -> Optional[dict]:
+        """``{"p50": ..., "p95": ..., "p99": ...}`` for one series (one
+        snapshot, N estimates), or None for an empty/absent series."""
+        s = self.snapshot(**labels)
+        if not s or not s["count"]:
+            return None
+        return {
+            f"p{q * 100:g}": percentile_from_buckets(self.buckets, s, q)
+            for q in qs
+        }
 
 
 class MetricsRegistry:
@@ -236,7 +270,7 @@ class MetricsRegistry:
         lines: List[str] = []
         for m in self.metrics():
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for key, val in sorted(m.series().items()):
                 if isinstance(val, dict):  # histogram
@@ -256,6 +290,28 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def percentile_from_buckets(buckets, series: dict, q: float) -> Optional[float]:
+    """The quantile estimator shared by :meth:`Histogram.percentile` and
+    offline consumers (``telemetry.summary()``, ``--phase-breakdown``):
+    walk the fixed ``buckets`` against one series' per-bucket counts, then
+    interpolate linearly inside the bucket covering rank ``q * count``.
+    Observations above the last finite bound clamp to it (the Prometheus
+    ``histogram_quantile`` convention)."""
+    count = series.get("count", 0)
+    if not count:
+        return None
+    target = q * count
+    cum = 0
+    lo = 0.0
+    for ub, n in zip(buckets, series["buckets"]):
+        if n:
+            if cum + n >= target:
+                return lo + (ub - lo) * (target - cum) / n
+            cum += n
+        lo = ub
+    return float(buckets[-1])  # overflow (+Inf) bucket: clamp
+
+
 def _fmt_float(v) -> str:
     """Render a number the way Prometheus text format expects."""
     if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
@@ -263,8 +319,22 @@ def _fmt_float(v) -> str:
     return str(v)
 
 
+def _escape_label_value(v: str) -> str:
+    """Label-value escaping per text format 0.0.4: backslash, double-quote
+    and line feed must be escaped or a scrape with e.g. a peer address of
+    ``"\\n"`` in a label silently corrupts the whole exposition."""
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping per text format 0.0.4 (backslash and line feed)."""
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
 def _fmt_labels(key: LabelKey, **extra) -> str:
-    parts = [f'{k}="{v}"' for k, v in key] + [f'{k}="{v}"' for k, v in extra.items()]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key] + [
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in extra.items()
+    ]
     return "{" + ",".join(parts) + "}" if parts else ""
 
 

@@ -32,18 +32,31 @@ class Timeline:
     def __init__(self, maxlen: int = 65536):
         self._events: Deque[dict] = deque(maxlen=maxlen)
         self._seq = 0
+        self.dropped = 0  # events evicted past the ring bound
 
     def record(self, kind: str, **fields) -> None:
         """Append one event (no-op while telemetry is disabled).
 
         ``fields`` must be JSON-serializable; ``seq`` (process order) and
-        ``t`` (perf_counter seconds) are stamped here."""
-        if not _metrics.registry().enabled:
+        ``t`` (perf_counter seconds) are stamped here.  Appending past the
+        ring bound evicts the oldest event and counts it in :attr:`dropped`
+        (mirrored to the ``timeline_events_dropped_total`` counter and
+        ``telemetry.summary()``) — silent truncation would otherwise read
+        as "the session only just started" in an export."""
+        reg = _metrics.registry()
+        if not reg.enabled:
             return
         self._seq += 1
         ev = {"seq": self._seq, "t": time.perf_counter(), "kind": kind}
         ev.update(fields)
-        self._events.append(ev)
+        events = self._events
+        if len(events) == events.maxlen:
+            self.dropped += 1
+            reg.counter(
+                "timeline_events_dropped_total",
+                "timeline events evicted past the ring bound",
+            ).inc()
+        events.append(ev)
 
     def events(self, kind: Optional[str] = None, **field_filter) -> List[dict]:
         """Recorded events in order, optionally filtered by kind/fields."""
@@ -62,9 +75,10 @@ class Timeline:
         return evs[-n:] if n > 0 else []
 
     def clear(self) -> None:
-        """Drop all events and reset the sequence counter."""
+        """Drop all events and reset the sequence/dropped counters."""
         self._events.clear()
         self._seq = 0
+        self.dropped = 0
 
     def __len__(self) -> int:
         return len(self._events)

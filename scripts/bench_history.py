@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Bench-history regression gate over the BENCH_r*.json trajectory.
+
+Each bench round writes one ``BENCH_rNN.json`` record (``{"n", "cmd", "rc",
+"tail", "parsed": {...}}`` — see bench.py).  This tool reads the whole
+trajectory, compares the LATEST record's throughput metrics against the best
+prior value of each metric, prints a per-stage delta table, and exits
+nonzero when any metric regressed more than ``--threshold`` (default 10%).
+
+Rules that keep the gate honest on this heterogeneous history:
+
+- only records with ``rc == 0`` count (a crashed round proves nothing);
+- only prior records on the SAME platform as the latest are compared
+  (a CPU round "regressing" against a TPU round is not a regression);
+- only higher-is-better throughput metrics participate (``*fps*``,
+  ``*per_sec*``, ``*speedup*``, and the headline ``value``) — spreads,
+  byte counts and percentages are reported by bench.py but not gated;
+- metrics the latest record does not carry are skipped, not failed
+  (stage sets grew over rounds — r01 had no batched stage).
+
+``scripts/check.sh`` runs this with ``--warn-only`` (soft gate: the table
+prints, regressions warn, the exit code stays 0) because single-shot bench
+numbers on a shared 1-core host are noisy; CI trend enforcement should run
+it bare after a reps>=5 bench run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# higher-is-better selector: any numeric parsed key matching one of these is
+# a gated throughput metric ("value" is the headline resim fps)
+_METRIC_RE = re.compile(r"(fps|per_sec|speedup|ticks_per_sec)")
+_EXCLUDE_RE = re.compile(r"(spread|bytes|pct|entities|depth|reps|lobbies)")
+
+
+def load_records(dir: str) -> list:
+    """All parsable ``BENCH_r*.json`` records in round order, as
+    ``(round, parsed_dict)`` pairs; crashed (rc != 0) and malformed records
+    are dropped with a note on stderr."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(dir, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_history: skipping {path}: {e}", file=sys.stderr)
+            continue
+        if rec.get("rc", 0) != 0:
+            print(f"bench_history: skipping {path}: rc={rec['rc']}",
+                  file=sys.stderr)
+            continue
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict):
+            out.append((int(m.group(1)), parsed))
+    return out
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    """Nested parsed dicts -> dotted flat keys (``stage_platforms.batched``)."""
+    flat = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key + "."))
+        else:
+            flat[key] = v
+    return flat
+
+
+def throughput_metrics(parsed: dict) -> dict:
+    """The gated higher-is-better numeric metrics of one parsed record."""
+    out = {}
+    for k, v in _flatten(parsed).items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if _EXCLUDE_RE.search(k):
+            continue
+        if k == "value" or _METRIC_RE.search(k):
+            out[k] = float(v)
+    return out
+
+
+def compare(records: list, threshold: float) -> tuple:
+    """Latest-vs-best-prior comparison.
+
+    Returns ``(rows, regressions)`` where each row is ``(metric, best_prior,
+    best_round, latest, delta_frac_or_None)``.  ``regressions`` lists the
+    rows whose delta is below ``-threshold``."""
+    latest_round, latest = records[-1]
+    platform = latest.get("platform")
+    priors = [
+        (n, p) for n, p in records[:-1]
+        if platform is None or p.get("platform") == platform
+    ]
+    latest_m = throughput_metrics(latest)
+    rows, regressions = [], []
+    for metric in sorted(latest_m):
+        best = best_round = None
+        for n, p in priors:
+            v = throughput_metrics(p).get(metric)
+            if v is not None and v > 0 and (best is None or v > best):
+                best, best_round = v, n
+        if best is None:
+            rows.append((metric, None, None, latest_m[metric], None))
+            continue
+        delta = (latest_m[metric] - best) / best
+        row = (metric, best, best_round, latest_m[metric], delta)
+        rows.append(row)
+        if delta < -threshold:
+            regressions.append(row)
+    return (latest_round, platform, rows, regressions)
+
+
+def print_table(latest_round: int, platform, rows: list,
+                threshold: float) -> None:
+    """The per-stage delta table (stdout)."""
+    print(f"bench history: BENCH_r{latest_round:02d} (platform={platform}) "
+          f"vs best prior same-platform record, threshold {threshold:.0%}")
+    w = max((len(r[0]) for r in rows), default=6)
+    print(f"  {'metric':<{w}}  {'best prior':>12}  {'latest':>12}  delta")
+    for metric, best, best_round, latest, delta in rows:
+        if delta is None:
+            print(f"  {metric:<{w}}  {'-':>12}  {latest:>12.1f}  (new)")
+            continue
+        flag = "  << REGRESSION" if delta < -threshold else ""
+        print(f"  {metric:<{w}}  {best:>9.1f}(r{best_round:02d})"
+              f"  {latest:>12.1f}  {delta:+7.1%}{flag}")
+
+
+def main(argv=None) -> int:
+    """CLI entry point; returns the process exit code."""
+    ap = argparse.ArgumentParser(
+        description="compare the latest BENCH_r*.json against the best "
+                    "prior record and gate on throughput regressions")
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default: .)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression fraction that fails the gate "
+                         "(default: 0.10 = 10%%)")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="print the table and warnings but always exit 0")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.dir)
+    if len(records) < 2:
+        print("bench_history: fewer than two usable records — nothing to "
+              "compare")
+        return 0
+    latest_round, platform, rows, regressions = compare(
+        records, args.threshold
+    )
+    print_table(latest_round, platform, rows, args.threshold)
+    if not any(r[4] is not None for r in rows):
+        print("bench_history: no same-platform prior record — no gate")
+        return 0
+    if regressions:
+        names = ", ".join(r[0] for r in regressions)
+        print(f"bench_history: {len(regressions)} metric(s) regressed more "
+              f"than {args.threshold:.0%}: {names}", file=sys.stderr)
+        return 0 if args.warn_only else 1
+    print("bench_history: no regressions past threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

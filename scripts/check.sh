@@ -37,4 +37,9 @@ python bench.py --smoke
 echo "== bench =="
 python bench.py
 
+echo "== bench history (soft gate: warns on >10% throughput regression) =="
+# single-shot numbers on a shared host are noisy — the table and warnings
+# print, the exit code stays 0; run without --warn-only to enforce
+python scripts/bench_history.py --warn-only
+
 echo "ALL CHECKS PASSED"

@@ -54,6 +54,19 @@ PURITY_ATTRS = {"to_int", "block_until_ready", "device_get"}
 # bare-name calls that force
 PURITY_NAMES = {"checksum_to_int"}
 
+# -- tick-phase timer discipline --------------------------------------------
+# Mirror of bevy_ggrs_tpu.telemetry.phases.PHASES (stdlib-only: importing
+# the package pulls jax, which this gate must not do).  tests/test_phases.py
+# asserts the two stay identical.  Every ``.phase("<literal>")`` call in the
+# drivers must name a catalog phase (a typo would silently leak its time
+# into unattributed_ms) and must be a ``with``-statement context expression
+# (a bare call never runs __enter__/__exit__, so it times nothing).
+PHASE_CATALOG = {
+    "net_poll", "session_step", "stage_inputs", "wave_dispatch",
+    "readback_harvest", "rollback_load", "store_save",
+}
+PHASE_FILES = ("bevy_ggrs_tpu/runner.py", "bevy_ggrs_tpu/batch_runner.py")
+
 
 def _purity_allowlist(path: Path):
     """The allowlist for ``path`` if the purity lint covers it, else None."""
@@ -90,6 +103,63 @@ def check_purity(tree: ast.AST, allow: set) -> list:
 
     walk(tree, None)
     return problems
+
+
+def check_phases(tree: ast.AST) -> list:
+    """Return ``(line, message)`` for ``.phase(...)`` misuse in a driver:
+    a non-literal or non-catalog phase name, or a call that is not a
+    ``with``-statement context expression (timing nothing)."""
+    problems = []
+    with_exprs = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_exprs.add(id(item.context_expr))
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "phase"
+        ):
+            continue
+        if (
+            len(node.args) != 1
+            or node.keywords
+            or not isinstance(node.args[0], ast.Constant)
+            or not isinstance(node.args[0].value, str)
+        ):
+            problems.append((
+                node.lineno,
+                "phase timer: .phase() takes one string literal "
+                "(dynamic names defeat the catalog lint)",
+            ))
+            continue
+        name = node.args[0].value
+        if name not in PHASE_CATALOG:
+            problems.append((
+                node.lineno,
+                f"phase timer: {name!r} is not in the phase catalog "
+                f"{sorted(PHASE_CATALOG)} — its time would silently land "
+                "in unattributed_ms (telemetry/phases.py)",
+            ))
+        if id(node) not in with_exprs:
+            problems.append((
+                node.lineno,
+                f"phase timer: .phase({name!r}) must be a with-statement "
+                "context expression — a bare call times nothing",
+            ))
+    return problems
+
+
+def _check_phases_file(path: Path) -> list:
+    posix = path.as_posix()
+    if not any(posix.endswith(s) for s in PHASE_FILES):
+        return []
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError:
+        return []  # the import lint reports the syntax error
+    return check_phases(tree)
 
 
 def _check_purity_file(path: Path) -> list:
@@ -185,10 +255,14 @@ def main(argv) -> int:
     """Lint the given paths; return a non-zero exit code on any finding."""
     paths = argv[1:] or list(DEFAULT_PATHS)
     files = _iter_files(paths)
-    # the purity lint runs regardless of which import checker is available
+    # the purity + phase-timer lints run regardless of which import checker
+    # is available
     pure_bad = 0
     for f in files:
         for lineno, msg in _check_purity_file(f):
+            print(f"{f}:{lineno}: {msg}")
+            pure_bad += 1
+        for lineno, msg in _check_phases_file(f):
             print(f"{f}:{lineno}: {msg}")
             pure_bad += 1
     try:
@@ -197,7 +271,7 @@ def main(argv) -> int:
 
         rep = Reporter(sys.stdout, sys.stderr)
         bad = sum(checkPath(str(f), rep) for f in files)
-        print(f"lint (pyflakes + purity): {len(files)} files, "
+        print(f"lint (pyflakes + purity + phases): {len(files)} files, "
               f"{bad + pure_bad} problems")
         return 1 if bad + pure_bad else 0
     except ImportError:
@@ -207,7 +281,7 @@ def main(argv) -> int:
         for lineno, msg in _check_file(f):
             print(f"{f}:{lineno}: {msg}")
             bad += 1
-    print(f"lint (stdlib ast + purity): {len(files)} files, "
+    print(f"lint (stdlib ast + purity + phases): {len(files)} files, "
           f"{bad + pure_bad} problems")
     return 1 if bad + pure_bad else 0
 

@@ -83,6 +83,10 @@ def main():
     ap.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="enable telemetry and write the profiled ticks' "
                          "timeline (spans, rollbacks, dispatches) as JSONL")
+    ap.add_argument("--phase-breakdown", action="store_true",
+                    help="print per-phase p50/p95/p99 latency over the "
+                         "profiled window (exact values from the flight "
+                         "recorder; needs no telemetry)")
     args = ap.parse_args()
 
     import jax
@@ -104,6 +108,13 @@ def main():
 
     if args.telemetry_out:
         telemetry.reset()  # drop warmup events: export the profiled window only
+    if args.phase_breakdown:
+        from bevy_ggrs_tpu import telemetry as _tel
+
+        fr = _tel.flight_recorder()
+        # the ring must hold the whole profiled window for exact percentiles
+        fr.set_maxlen(max(fr.maxlen, args.ticks * len(runners) + 16))
+        fr.clear()
     clear_trace_events()
     t0 = time.perf_counter()
     with runners[0].profile(args.logdir):
@@ -146,6 +157,13 @@ def main():
           f"{(wall - top_total - drain) * 1e3 / runner_ticks:8.3f} "
           f"ms/runner-tick  (includes blocking waits inside spans' callees "
           f"on CPU)")
+    if args.phase_breakdown:
+        from bevy_ggrs_tpu import telemetry as _tel
+
+        print("per-phase latency over the profiled window (ms/tick, exact):")
+        print(_tel.format_phase_table(
+            _tel.phase_breakdown(_tel.flight_recorder().snapshot("tick"))
+        ))
     print(f"device trace written to {args.logdir} (view with xprof/"
           f"tensorboard)")
     if args.telemetry_out:

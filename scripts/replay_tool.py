@@ -46,9 +46,9 @@ def cmd_checksums(args):
     from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
     from bevy_ggrs_tpu import models
 
-    if args.telemetry_out:
-        from bevy_ggrs_tpu import telemetry
+    from bevy_ggrs_tpu import telemetry
 
+    if args.telemetry_out:
         telemetry.enable()
     rec = load(args.recording)
     app = getattr(models, args.model).make_app(num_players=rec.num_players)
@@ -56,6 +56,11 @@ def cmd_checksums(args):
     app.canonical_depth = rec.canonical_depth
     app.canonical_branches = rec.canonical_branches
     runner = GgrsRunner(app, ReplaySession(rec))
+    if args.phase_breakdown:
+        fr = telemetry.flight_recorder()
+        # size the ring to the whole replay so the percentiles are exact
+        fr.set_maxlen(max(fr.maxlen, len(rec.frames) + 16))
+        fr.clear()
     while not runner.session.finished:
         runner.tick()
         if runner.frame % args.every == 0:
@@ -63,6 +68,11 @@ def cmd_checksums(args):
                   f"{checksum_to_int(runner._world_checksum):#018x}")
     print(f"final frame {runner.frame}: "
           f"{checksum_to_int(runner._world_checksum):#018x}")
+    if args.phase_breakdown:
+        print("per-phase latency over the replay (ms/tick, exact):")
+        print(telemetry.format_phase_table(
+            telemetry.phase_breakdown(fr.snapshot("tick"))
+        ))
     if args.telemetry_out:
         n = telemetry.export_jsonl(args.telemetry_out)
         print(f"telemetry timeline: {n} events -> {args.telemetry_out}")
@@ -96,6 +106,10 @@ def main():
     p.add_argument("--telemetry-out", default=None, metavar="PATH",
                    help="enable telemetry and write the replay's timeline "
                         "(spans, rollbacks, dispatches) as JSONL")
+    p.add_argument("--phase-breakdown", action="store_true",
+                   help="print per-phase p50/p95/p99 latency over the "
+                        "replay (exact values from the flight recorder; "
+                        "needs no telemetry)")
     p = sub.add_parser("diff")
     p.add_argument("a")
     p.add_argument("b")
