@@ -674,6 +674,73 @@ PIPELINE_WARM = 50
 PIPELINE_MIN_SPEEDUP = 1.15
 
 
+def _make_p2p_pair(pipelined, tag, inputs=None, latency_hops=None,
+                   input_delay=2, entities=PIPELINE_ENTITIES):
+    """Build a two-runner p2p loopback pair over ``ChannelNetwork``.
+
+    Shared by :func:`stage_pipeline` and :func:`stage_netstats`.  ``inputs``
+    is an optional factory: ``inputs(i)`` returns the ``read_inputs``
+    callable for runner ``i``; the default is constant zeros — the
+    misprediction-free workload the pipeline comparison wants.  Pass
+    ``latency_hops`` > ``input_delay`` plus varying inputs to make served
+    predictions genuinely wrong (rollbacks with attributable blame)."""
+    import numpy as np
+
+    from bevy_ggrs_tpu import (
+        DesyncDetection, GgrsRunner, PlayerType, SessionBuilder,
+    )
+    from bevy_ggrs_tpu.models import stress_soa
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+    from bevy_ggrs_tpu.session.events import SessionState
+
+    kw = {} if latency_hops is None else {"latency_hops": latency_hops}
+    net = ChannelNetwork(seed=7, **kw)
+    socks = [net.endpoint(f"{tag}{i}") for i in range(2)]
+    runners = []
+    for i in range(2):
+        app = stress_soa.make_app(entities)
+        builder = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(input_delay)
+            .with_desync_detection_mode(DesyncDetection.on(1))
+            .with_eager_checksums(not pipelined)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"{tag}{1 - i}")
+        )
+        session = builder.start_p2p_session(socks[i])
+        read = (inputs(i) if inputs is not None
+                else (lambda handles: {h: np.uint8(0) for h in handles}))
+        runners.append(GgrsRunner(
+            app, session, read_inputs=read, pipeline=pipelined,
+        ))
+    for _ in range(500):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING
+               for r in runners):
+            break
+    else:
+        raise RuntimeError(f"{tag} pair never reached RUNNING")
+    return net, runners
+
+
+def _slice_ticks(jax, net, runners, ticks, dt):
+    """Run one timed slice of ``ticks`` updates over a p2p pair.
+
+    Device work raised by a slice is retired inside it, so the elapsed
+    time is attributable: the sync arm already blocks per update, the
+    pipelined arm settles its in-flight window here."""
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        net.deliver()
+        for r in runners:
+            r.update(dt)
+    for r in runners:
+        jax.block_until_ready(r._world.comps)
+    return time.perf_counter() - t0
+
+
 def stage_pipeline():
     """Pipelined vs synchronous tick engine over a p2p loopback pair.
 
@@ -692,85 +759,35 @@ def stage_pipeline():
     HARD GATES: (1) forced readbacks per steady-state pipelined tick == 0;
     (2) pipelined >= 1.15x sync ticks/sec on CPU.  Both raise."""
     jax = _stage_setup()
-    import numpy as np
-
-    from bevy_ggrs_tpu import (
-        DesyncDetection, GgrsRunner, PlayerType, SessionBuilder,
-    )
-    from bevy_ggrs_tpu.models import stress_soa
-    from bevy_ggrs_tpu.session.channel import ChannelNetwork
-    from bevy_ggrs_tpu.session.events import SessionState
     from bevy_ggrs_tpu.snapshot.lazy import readback_stats
 
-    def make_pair(pipelined, tag):
-        net = ChannelNetwork(seed=7)
-        socks = [net.endpoint(f"{tag}{i}") for i in range(2)]
-        runners = []
-        for i in range(2):
-            app = stress_soa.make_app(PIPELINE_ENTITIES)
-            builder = (
-                SessionBuilder.for_app(app)
-                .with_input_delay(2)
-                .with_desync_detection_mode(DesyncDetection.on(1))
-                .with_eager_checksums(not pipelined)
-                .add_player(PlayerType.LOCAL, i)
-                .add_player(PlayerType.REMOTE, 1 - i, f"{tag}{1 - i}")
-            )
-            session = builder.start_p2p_session(socks[i])
-            runners.append(GgrsRunner(
-                app, session,
-                read_inputs=lambda handles: {
-                    h: np.uint8(0) for h in handles
-                },
-                pipeline=pipelined,
-            ))
-        for _ in range(500):
-            net.deliver()
-            for r in runners:
-                r.update(0.0)
-            if all(r.session.current_state() == SessionState.RUNNING
-                   for r in runners):
-                break
-        else:
-            raise RuntimeError(f"{tag} pair never reached RUNNING")
-        return net, runners
-
-    def slice_ticks(net, runners, ticks, dt):
-        # device work raised by a slice is retired inside it, so the
-        # elapsed time is attributable: the sync arm already blocks per
-        # update, the pipelined arm settles its in-flight window here
-        t0 = time.perf_counter()
-        for _ in range(ticks):
-            net.deliver()
-            for r in runners:
-                r.update(dt)
-        for r in runners:
-            jax.block_until_ready(r._world.comps)
-        return time.perf_counter() - t0
-
-    net_s, sync_runners = make_pair(False, "sync")
-    net_p, pipe_runners = make_pair(True, "pipe")
+    net_s, sync_runners = _make_p2p_pair(False, "sync")
+    net_p, pipe_runners = _make_p2p_pair(True, "pipe")
     dt = 1.0 / sync_runners[0].app.fps
-    slice_ticks(net_s, sync_runners, PIPELINE_WARM, dt)
-    slice_ticks(net_p, pipe_runners, PIPELINE_WARM, dt)
+    _slice_ticks(jax, net_s, sync_runners, PIPELINE_WARM, dt)
+    _slice_ticks(jax, net_p, pipe_runners, PIPELINE_WARM, dt)
 
     sync_tps, pipe_tps = [], []
     forced_pipe = harvested_pipe = forced_sync = 0
     blocked_sync = 0.0
     for _ in range(PIPELINE_ROUNDS):
         s0 = readback_stats()
-        elapsed = slice_ticks(net_s, sync_runners, PIPELINE_SLICE, dt)
+        elapsed = _slice_ticks(jax, net_s, sync_runners, PIPELINE_SLICE, dt)
         s1 = readback_stats()
         sync_tps.append(PIPELINE_SLICE / elapsed)
         forced_sync += s1["forced"] - s0["forced"]
         blocked_sync += s1["blocked_seconds"] - s0["blocked_seconds"]
-        elapsed = slice_ticks(net_p, pipe_runners, PIPELINE_SLICE, dt)
+        elapsed = _slice_ticks(jax, net_p, pipe_runners, PIPELINE_SLICE, dt)
         s2 = readback_stats()
         pipe_tps.append(PIPELINE_SLICE / elapsed)
         forced_pipe += s2["forced"] - s1["forced"]
         harvested_pipe += s2["harvested"] - s1["harvested"]
 
     degrades = sum(r.stats()["pipeline_degrades"] for r in pipe_runners)
+    netstats_attached = all(r._netstats is not None
+                            for r in (*sync_runners, *pipe_runners))
+    netstats_every = (pipe_runners[0]._netstats.every
+                      if pipe_runners[0]._netstats is not None else 0)
     for r in (*sync_runners, *pipe_runners):
         r.finish()
 
@@ -833,6 +850,13 @@ def stage_pipeline():
             k: round(v * 1e3, 1) for k, v in phase_tot.items()
         },
         "pipeline_unattributed_pct": unattr_pct,
+        "pipeline_netstats": {
+            # the per-peer sampler rides the same net_poll phase these
+            # arms time; stage_netstats gates its cost, this just records
+            # that both arms carried it at the env-resolved cadence
+            "sampler_attached": netstats_attached,
+            "every": netstats_every,
+        },
         "pipeline_entities": PIPELINE_ENTITIES,
         "pipeline_rep_policy": (
             f"paired alternating {PIPELINE_SLICE}-tick slices x "
@@ -840,6 +864,178 @@ def stage_pipeline():
             "median of per-round pipe/sync ratios; per-arm ticks/s = "
             "trimmed mean over rounds (drop 1 min + 1 max)"),
         "platform": platform,
+    }
+
+
+NETSTATS_TICKS = 200
+NETSTATS_EVERY = 8
+NETSTATS_POLL_CALLS = 20_000
+NETSTATS_MAX_OVERHEAD_PCT = 1.0
+
+
+def stage_netstats():
+    """Network observability: rollback-cause attribution + per-peer sampler.
+
+    A two-runner p2p pair runs over ``ChannelNetwork(latency_hops=3)`` with
+    ``input_delay=1`` and inputs flipping every 7 ticks, so served
+    predictions genuinely mispredict: every rollback the drivers execute
+    must carry a blamed handle (docs/observability.md "Network & QoS").
+    Two timed slices run — sampler disabled, then sampler at ``every=8`` —
+    and the sampler's per-call cost is additionally measured by a direct
+    ``poll()`` microbenchmark so the overhead gate does not ride on two
+    noisy wall-clock slices alone.
+
+    HARD GATES (raise -> nonzero exit):
+
+    1. attribution completeness — sum over handles of
+       ``rollback_cause_total`` == ``rollbacks_total``, with > 0 rollbacks
+       observed and no ``handle=unknown`` on this fully-attributed path;
+    2. sampler cost — the amortized enabled ``poll()`` is < 1% of the
+       measured tick wall time, and the disabled ``poll()`` (the
+       ``BGT_NETSTATS_EVERY=0`` path) is a sub-microsecond boolean check;
+    3. ``/qos`` — an exporter on an ephemeral port serves JSON whose
+       ``lobby_qos_score`` values are finite and within [0, 100].
+
+    Reports sampler-off vs sampler-on ticks/s, per-handle cause counts,
+    lateness p95, sweep counts and the QoS snapshot.  ``BGT_BENCH_SMOKE=1``
+    shrinks the slices; every gate stays armed."""
+    jax = _stage_setup()
+    import json as _json
+    import urllib.request
+
+    from bevy_ggrs_tpu import telemetry
+    from bevy_ggrs_tpu.telemetry.netstats import NetStatsSampler
+
+    smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
+    ticks = 60 if smoke else NETSTATS_TICKS
+
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+
+    def flipping_inputs(i):
+        count = [0]
+
+        def read(handles):
+            count[0] += 1
+            return {h: np.uint8((count[0] // 7) % 2) for h in handles}
+
+        return read
+
+    net, runners = _make_p2p_pair(
+        False, "net", inputs=flipping_inputs, latency_hops=3, input_delay=1,
+    )
+    dt = 1.0 / runners[0].app.fps
+    _slice_ticks(jax, net, runners, ticks, dt)  # warmup (compile + sync)
+
+    for r in runners:
+        r._netstats = NetStatsSampler(r.session, every=0)
+    wall_off = _slice_ticks(jax, net, runners, ticks, dt)
+    for r in runners:
+        r._netstats = NetStatsSampler(r.session, every=NETSTATS_EVERY)
+    wall_on = _slice_ticks(jax, net, runners, ticks, dt)
+    sweeps = sum(r._netstats.samples for r in runners)
+    if sweeps == 0:
+        raise RuntimeError(
+            f"netstats gate: sampler took no sweeps in {ticks} ticks at "
+            f"every={NETSTATS_EVERY}"
+        )
+
+    # snapshot before the poll() microbenchmark below so the reported
+    # sweep/sample counts reflect the timed slices, not the 20k-call loop
+    snap = telemetry.registry().snapshot()
+
+    # poll() microbenchmark: disabled must be a boolean-check no-op,
+    # enabled amortizes one sweep per `every` calls
+    off_sampler = NetStatsSampler(runners[0].session, every=0)
+    t0 = time.perf_counter()
+    for _ in range(NETSTATS_POLL_CALLS):
+        off_sampler.poll()
+    poll_off_us = (time.perf_counter() - t0) / NETSTATS_POLL_CALLS * 1e6
+    on_sampler = NetStatsSampler(runners[0].session, every=NETSTATS_EVERY)
+    t0 = time.perf_counter()
+    for _ in range(NETSTATS_POLL_CALLS):
+        on_sampler.poll()
+    poll_on_us = (time.perf_counter() - t0) / NETSTATS_POLL_CALLS * 1e6
+    tick_ms = wall_on / (2 * ticks)  # two runners share each slice tick
+    tick_ms *= 1e3
+    overhead_pct = 100.0 * poll_on_us / 1e3 / tick_ms if tick_ms else 0.0
+    if overhead_pct >= NETSTATS_MAX_OVERHEAD_PCT:
+        raise RuntimeError(
+            f"netstats gate: enabled sampler poll() costs {poll_on_us:.2f}"
+            f"us/call = {overhead_pct:.3f}% of the {tick_ms:.3f}ms tick "
+            f"(required: < {NETSTATS_MAX_OVERHEAD_PCT}%)"
+        )
+    if poll_off_us >= 1.0:
+        raise RuntimeError(
+            f"netstats gate: DISABLED sampler poll() costs "
+            f"{poll_off_us:.2f}us/call — the BGT_NETSTATS_EVERY=0 path "
+            "must stay a single boolean check (< 1us)"
+        )
+
+    rollbacks = sum(snap.get("rollbacks_total", {}).get(
+        "series", {}).values())
+    causes = snap.get("rollback_cause_total", {}).get("series", {})
+    if rollbacks == 0:
+        raise RuntimeError(
+            "netstats gate: latency_hops=3 + flipping inputs forced no "
+            "rollbacks — the attribution path was never exercised"
+        )
+    if sum(causes.values()) != rollbacks:
+        raise RuntimeError(
+            "netstats gate: attribution is incomplete: "
+            f"sum(rollback_cause_total)={sum(causes.values())} != "
+            f"rollbacks_total={rollbacks} ({causes})"
+        )
+    if "handle=unknown" in causes:
+        raise RuntimeError(
+            "netstats gate: p2p mispredictions produced "
+            f"handle=unknown blame: {causes}"
+        )
+    lat = telemetry.registry().histogram("input_lateness_frames")
+    lateness_p95 = max(
+        (lat.percentile(0.95, handle=h) or 0.0) for h in (0, 1)
+    )
+
+    exporter = telemetry.start_http_exporter(port=0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exporter.port}/qos", timeout=10
+        ) as resp:
+            qos = _json.loads(resp.read().decode("utf-8"))
+    finally:
+        exporter.close()
+    scores = qos.get("lobby_qos_score") or {}
+    if not scores or not all(0.0 <= v <= 100.0 for v in scores.values()):
+        raise RuntimeError(
+            f"netstats gate: /qos served no usable lobby_qos_score: {qos!r}"
+        )
+
+    samples_total = sum(snap.get("netstats_samples_total", {}).get(
+        "series", {}).values())
+    for r in runners:
+        r.finish()
+    plat = jax.devices()[0].platform
+    telemetry.disable()
+    telemetry.reset()
+    return {
+        "netstats_ticks_per_sec_off": round(2 * ticks / wall_off, 1),
+        "netstats_ticks_per_sec_on": round(2 * ticks / wall_on, 1),
+        "netstats_poll_disabled_us": round(poll_off_us, 3),
+        "netstats_poll_enabled_us": round(poll_on_us, 3),
+        "netstats_overhead_pct_of_tick": round(overhead_pct, 4),
+        "netstats_sweeps": sweeps,
+        "netstats_samples_total": samples_total,
+        "netstats_every": NETSTATS_EVERY,
+        "netstats_rollbacks_total": rollbacks,
+        "netstats_rollback_causes": causes,
+        "netstats_lateness_p95_frames": round(lateness_p95, 2),
+        "netstats_qos": {
+            "lobby_qos_score": scores,
+            "inputs": {k: v.get("inputs") for k, v in
+                       (qos.get("lobbies") or {}).items()},
+        },
+        "platform": plat,
     }
 
 
@@ -856,6 +1052,7 @@ STAGES = {
     "layouts": (stage_layouts, 420),
     "telemetry": (stage_telemetry, 420),
     "pipeline": (stage_pipeline, 600),
+    "netstats": (stage_netstats, 420),
 }
 
 
@@ -1103,7 +1300,25 @@ def orchestrate():
             "spread": merged.get("pipeline_spread"),
             "spread_raw": merged.get("pipeline_spread_raw"),
             "entities": merged.get("pipeline_entities"),
+            "netstats": merged.get("pipeline_netstats"),
             "rep_policy": merged.get("pipeline_rep_policy"),
+        },
+        "netstats": {
+            "ticks_per_sec_sampler_off": merged.get(
+                "netstats_ticks_per_sec_off"),
+            "ticks_per_sec_sampler_on": merged.get(
+                "netstats_ticks_per_sec_on"),
+            "poll_disabled_us": merged.get("netstats_poll_disabled_us"),
+            "poll_enabled_us": merged.get("netstats_poll_enabled_us"),
+            "overhead_pct_of_tick": merged.get(
+                "netstats_overhead_pct_of_tick"),
+            "sweeps": merged.get("netstats_sweeps"),
+            "every": merged.get("netstats_every"),
+            "rollbacks_total": merged.get("netstats_rollbacks_total"),
+            "rollback_causes": merged.get("netstats_rollback_causes"),
+            "lateness_p95_frames": merged.get(
+                "netstats_lateness_p95_frames"),
+            "qos": merged.get("netstats_qos"),
         },
         "platform": headline_platform,
         "stage_platforms": stage_platforms,
@@ -1117,11 +1332,14 @@ def orchestrate():
 
 
 def smoke():
-    """CI smoke: the batched + sharded stages only, 1 rep, small iter counts
-    — seconds, not minutes — with BOTH O(1)-dispatch gates fully armed (a
-    dispatch-count regression in either executor fails this run).  The
-    sharded stage runs under forced 8-virtual-device CPU so the mesh path
-    is exercised even on single-chip hosts.  Wired into scripts/check.sh."""
+    """CI smoke: the batched + sharded + netstats stages only, 1 rep, small
+    iter counts — seconds, not minutes — with every hard gate fully armed
+    (a dispatch-count regression in either executor, a broken
+    rollback-cause invariant, or a sampler-cost regression fails this run).
+    The sharded stage runs under forced 8-virtual-device CPU so the mesh
+    path is exercised even on single-chip hosts; netstats runs on CPU (its
+    gates are host-loop properties, not device throughput).  Wired into
+    scripts/check.sh."""
     result, err = _run_stage(
         "batched", timeout_s=300, force_cpu=False,
         extra_env={"BGT_BENCH_SMOKE": "1"},
@@ -1140,17 +1358,26 @@ def smoke():
         print(f"bench smoke FAILED: sharded stage skipped under forced "
               f"8-device CPU: {sharded['sharded_skipped']}", file=sys.stderr)
         sys.exit(1)
+    netstats, err = _run_stage(
+        "netstats", timeout_s=300, force_cpu=True,
+        extra_env={"BGT_BENCH_SMOKE": "1"},
+    )
+    if netstats is None:
+        print(f"bench smoke FAILED (netstats stage): {err}", file=sys.stderr)
+        sys.exit(1)
     print(json.dumps({"smoke": "ok", **result,
                       "sharded": {k: v for k, v in sharded.items()
-                                  if k != "platform"}}))
+                                  if k != "platform"},
+                      "netstats": {k: v for k, v in netstats.items()
+                                   if k != "platform"}}))
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", choices=sorted(STAGES), default=None)
     ap.add_argument("--smoke", action="store_true",
-                    help="batched + sharded stages only, 1 rep, "
-                         "dispatch gates armed")
+                    help="batched + sharded + netstats stages only, 1 rep, "
+                         "all hard gates armed")
     args = ap.parse_args()
     if args.stage:
         from bevy_ggrs_tpu.utils.platform import apply_platform_env

@@ -77,10 +77,11 @@ from .utils.tracing import span
 
 
 class _Op:
-    __slots__ = ("load_frame", "run")
+    __slots__ = ("load_frame", "load_cause", "run")
 
-    def __init__(self, load_frame=None, run=None):
+    def __init__(self, load_frame=None, run=None, load_cause=None):
         self.load_frame = load_frame  # int | None
+        self.load_cause = load_cause  # RollbackCause | None
         self.run = run  # List[GgrsRequest] | None
 
 
@@ -92,7 +93,7 @@ def _split_ops(requests: List[GgrsRequest]) -> List[_Op]:
     while i < n:
         r = requests[i]
         if isinstance(r, LoadRequest):
-            ops.append(_Op(load_frame=r.frame))
+            ops.append(_Op(load_frame=r.frame, load_cause=r.cause))
             i += 1
         else:
             j = i
@@ -417,24 +418,46 @@ class BatchedRunner:
 
     def _do_loads(self, wave_ops: List[Optional[_Op]]) -> None:
         loads = [
-            (b, op.load_frame)
+            (b, op.load_frame, op.load_cause)
             for b, op in enumerate(wave_ops)
             if op is not None and op.load_frame is not None
         ]
         if not loads:
             return
         self.rollbacks += len(loads)
-        for b, f in loads:
+        for b, f, _c in loads:
             self._phases.note_rollback(self.frames[b] - f)
         if telemetry.enabled():
-            for b, f in loads:
+            for b, f, cause in loads:
+                depth = self.frames[b] - f
+                # cause-less loads (legacy session types) blame "unknown" so
+                # rollback_cause_total summed over handles still equals
+                # rollbacks_total across every driver
+                blamed = cause.handle if cause is not None else "unknown"
+                if blamed is None:
+                    blamed = "unknown"
+                lateness = cause.lateness if cause is not None else depth
                 telemetry.count("rollbacks_total", lobby=b)
-                telemetry.observe(
-                    "rollback_depth", self.frames[b] - f, lobby=b,
+                telemetry.count(
+                    "rollback_cause_total",
+                    help="rollbacks attributed to the peer whose input "
+                         "caused them",
+                    lobby=b, handle=blamed,
                 )
-                telemetry.record("rollback", lobby=b, to_frame=f,
-                                 from_frame=self.frames[b],
-                                 depth=self.frames[b] - f)
+                telemetry.observe(
+                    "rollback_depth", depth, lobby=b,
+                )
+                telemetry.observe(
+                    "input_lateness_frames", lateness,
+                    "frames late the blamed input arrived",
+                    lobby=b, handle=blamed,
+                )
+                telemetry.record(
+                    "rollback", lobby=b, to_frame=f,
+                    from_frame=self.frames[b], depth=depth,
+                    handle=blamed, lateness=lateness,
+                    cause_kind=cause.kind if cause is not None else "unknown",
+                )
         with self._phases.phase("rollback_load"), span("LoadWorldBatched"):
             # batched mixed-source load: roll every ring back, group the
             # stored LazySlice handles by backing stacked buffer, and serve
@@ -442,7 +465,9 @@ class BatchedRunner:
             # dispatches' buffers — as ONE jitted gather+scatter.  A
             # non-identity strategy's load_state hook is vmapped into the
             # same program.
-            entries = rollback_many(self.rings, loads)
+            entries = rollback_many(
+                self.rings, [(b, f) for b, f, _c in loads]
+            )
             groups, fallback = plan_row_gather(
                 [(b, stored) for b, (stored, _cs) in entries]
             )
@@ -465,7 +490,7 @@ class BatchedRunner:
                 self._m_fallback_loads.inc()
             for b, (_stored, cs) in entries:
                 self._world_checksum[b] = cs
-            for b, f in loads:
+            for b, f, _c in loads:
                 self.frames[b] = f
 
     # -- runs ---------------------------------------------------------------

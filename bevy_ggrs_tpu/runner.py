@@ -177,6 +177,10 @@ class GgrsRunner:
         self._phases = telemetry.PhaseSet(owner="solo")
         self.compile_ms: Dict[str, float] = {}
         self._seen_variants: set = set()
+        # Periodic per-peer NetworkStats/TimeSync sampler (telemetry/
+        # netstats.py); attached by set_session for sessions that expose
+        # network_stats, polled inside the net_poll phase
+        self._netstats = None
         if session is not None:
             self.set_session(session)
 
@@ -273,6 +277,12 @@ class GgrsRunner:
             # sessions); mirror it so ctx.frame/time agree from tick one
             cur = getattr(session, "current_frame", 0)
             self.frame = cur() if callable(cur) else cur
+        if session is not None and hasattr(session, "network_stats"):
+            from .telemetry.netstats import NetStatsSampler
+
+            self._netstats = NetStatsSampler(session)
+        else:
+            self._netstats = None
 
     def _ring_depth(self, session) -> int:
         """Snapshot-ring capacity: the deepest rollback window the session
@@ -334,6 +344,8 @@ class GgrsRunner:
                 with span("PollRemoteClients"):
                     self.session.poll_remote_clients()
                 self._drain_events()
+                if self._netstats is not None:
+                    self._netstats.poll()
                 if telemetry.enabled():
                     self._record_network_stats()
         pending: List[GgrsRequest] = []
@@ -549,7 +561,9 @@ class GgrsRunner:
             try:
                 st = s.network_stats(h)
             except InvalidRequestError:
-                continue  # endpoint gone (disconnect)
+                continue  # endpoint gone (legacy raising sessions)
+            if not st.is_live:
+                continue  # local / spectator / disconnected handle
             telemetry.gauge_set("ping_ms", st.ping_ms, "round-trip ping", peer=h)
             telemetry.gauge_set(
                 "send_queue_len", st.send_queue_len, "pending outbound inputs",
@@ -589,16 +603,22 @@ class GgrsRunner:
         )
 
     def _report_desync(self, ev: DesyncDetected) -> None:
-        """P2P DesyncDetected: timeline event + forensics report."""
+        """P2P DesyncDetected: timeline event + forensics report.
+
+        The report carries every resolved local per-frame checksum the
+        session still holds, so two peers' reports can be frame-aligned
+        offline (``replay_tool.py merge-reports``)."""
         telemetry.record(
             "checksum_mismatch", source="p2p", frames=[ev.frame],
             local_checksum=ev.local_checksum,
             remote_checksum=ev.remote_checksum, addr=repr(ev.addr),
         )
+        local = getattr(self.session, "_local_checksums", None) or {}
         telemetry.write_desync_report(
             "p2p_desync", reg=self.app.reg, world=self.world,
             frames=[ev.frame], local_checksum=ev.local_checksum,
             remote_checksum=ev.remote_checksum, addr=ev.addr,
+            checksums={f: v for f, v in local.items() if isinstance(v, int)},
         )
 
     # -- request dispatch (the TPU-offload seam, SURVEY §3.6) ---------------
@@ -614,7 +634,7 @@ class GgrsRunner:
             while i < n:
                 r = requests[i]
                 if isinstance(r, LoadRequest):
-                    self._load(r.frame)
+                    self._load(r.frame, r.cause)
                     i += 1
                 else:
                     j = i
@@ -638,18 +658,49 @@ class GgrsRunner:
             if self.on_confirmed is not None and self.confirmed != NULL_FRAME:
                 self.on_confirmed(self.confirmed)
 
-    def _load(self, frame: int) -> None:
+    def _load(self, frame: int, cause=None) -> None:
         """LoadGameState: restore the ring snapshot for ``frame``
-        (schedule_systems.rs:238-249)."""
+        (schedule_systems.rs:238-249).
+
+        ``cause`` is the session's :class:`RollbackCause` attribution; when
+        a legacy/replay path supplies none the rollback is attributed to
+        handle ``"unknown"`` so ``rollback_cause_total`` summed over handles
+        always equals ``rollbacks_total``."""
+        depth = self.frame - frame
         self.rollbacks += 1
-        self._phases.note_rollback(self.frame - frame)
+        self._phases.note_rollback(depth)
+        blamed = cause.handle if cause is not None else "unknown"
+        if blamed is None:
+            blamed = "unknown"
+        lateness = cause.lateness if cause is not None else depth
+        kind = cause.kind if cause is not None else "unknown"
+        mismatch = bool(cause.mismatch) if cause is not None else False
         telemetry.count("rollbacks_total", help="LoadRequests executed")
         telemetry.observe(
-            "rollback_depth", self.frame - frame,
+            "rollback_depth", depth,
             "frames rolled back per LoadRequest",
         )
+        telemetry.count(
+            "rollback_cause_total",
+            help="rollbacks attributed to the peer whose input caused them",
+            handle=blamed,
+        )
+        telemetry.observe(
+            "input_lateness_frames", lateness,
+            "frames late the blamed input arrived (rollback depth it forced)",
+            handle=blamed,
+        )
         telemetry.record("rollback", to_frame=frame, from_frame=self.frame,
-                         depth=self.frame - frame)
+                         depth=depth, handle=blamed, lateness=lateness,
+                         mismatch=mismatch, cause_kind=kind)
+        fr = telemetry.flight_recorder()
+        if fr.enabled:
+            # the always-on ring gets the attributed entry too, so a desync
+            # report's flight_record section names the blamed peer even when
+            # the metrics registry was off
+            fr.record("rollback", to_frame=frame, from_frame=self.frame,
+                      depth=depth, handle=blamed, lateness=lateness,
+                      mismatch=mismatch, cause_kind=kind)
         with self._phases.phase("rollback_load"), span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
             was_lazy = isinstance(stored, LazySlice)

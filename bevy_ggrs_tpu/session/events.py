@@ -143,10 +143,17 @@ class InvalidRequestError(GgrsError):
 @dataclass
 class NetworkStats:
     """`network_stats(handle)` surface
-    (/root/reference/examples/box_game/box_game_p2p.rs:121-142)."""
+    (/root/reference/examples/box_game/box_game_p2p.rs:121-142).
+
+    ``is_live`` is False for handles with no live endpoint behind them —
+    local handles, disconnected peers, spectators.  Those return a zeroed
+    snapshot instead of raising, so samplers can walk every handle without
+    try/except churn (the :class:`~bevy_ggrs_tpu.telemetry.netstats.
+    NetStatsSampler` skips non-live snapshots silently)."""
 
     ping_ms: float = 0.0
     send_queue_len: int = 0
     kbps_sent: float = 0.0
     local_frames_behind: int = 0
     remote_frames_behind: int = 0
+    is_live: bool = True

@@ -40,6 +40,11 @@ class InputQueue:
         self.last_confirmed = NULL_FRAME  # newest frame with a real input
         self._predictions: Dict[int, np.ndarray] = {}  # frame -> served guess
         self.first_incorrect = NULL_FRAME
+        # True when first_incorrect was set by a served-prediction/actual
+        # disagreement; False when a disconnect-consensus truncation set it
+        # structurally (session._adopt_disconnect).  Read alongside
+        # take_first_incorrect() for rollback-cause attribution.
+        self.first_incorrect_mismatch = False
         self._base: int | None = None  # first frame of the stream, if known
 
     def default_input(self) -> np.ndarray:
@@ -80,6 +85,7 @@ class InputQueue:
                 frame, self.first_incorrect
             ):
                 self.first_incorrect = frame
+                self.first_incorrect_mismatch = True
 
     def set_base(self, base: int) -> None:
         """Anchor the contiguity mark at the sender's first-ever frame."""
@@ -122,7 +128,10 @@ class InputQueue:
         return self._inputs.get(frame)
 
     def take_first_incorrect(self) -> int:
-        """Pop the earliest mispredicted frame (NULL_FRAME if none)."""
+        """Pop the earliest mispredicted frame (NULL_FRAME if none).
+        ``first_incorrect_mismatch`` holds this pop's mismatch/structural
+        flag until the next first_incorrect is recorded — callers read it
+        immediately after popping (rollback-cause attribution)."""
         f = self.first_incorrect
         self.first_incorrect = NULL_FRAME
         return f

@@ -33,7 +33,13 @@ from .events import (
     Synchronized,
     Synchronizing,
 )
-from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
+from .requests import (
+    AdvanceRequest,
+    LoadRequest,
+    RollbackCause,
+    SaveCell,
+    SaveRequest,
+)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SO_PATH = os.path.join(_REPO_ROOT, "native", "libggrs_core.so")
@@ -164,6 +170,12 @@ class NativeP2PSession:
         )
         if not self._s:
             raise InvalidRequestError(f"could not bind UDP port {local_port}")
+        # remote player handles, for samplers and rollback-cause attribution
+        # (the native core does not export per-load blame, so the decode path
+        # below blames the unique remote handle when there is exactly one)
+        self._remote_handles = sorted(
+            p.handle for p in players if p.kind == PlayerType.REMOTE
+        )
         for p in players:
             if p.kind == PlayerType.LOCAL:
                 rc = self._lib.ggrs_p2p_add_player(self._s, 0, p.handle, None, 0)
@@ -230,6 +242,10 @@ class NativeP2PSession:
         n = self._lib.ggrs_p2p_local_handles(self._s, buf, self._num_players)
         return [int(buf[i]) for i in range(n)]
 
+    def remote_player_handles(self) -> List[int]:
+        """Handles owned by remote peers, ascending (sampler surface)."""
+        return list(self._remote_handles)
+
     def poll_remote_clients(self) -> None:
         """Drive the native socket/protocol; drain events and checksums."""
         self._lib.ggrs_p2p_poll(self._s)
@@ -249,6 +265,10 @@ class NativeP2PSession:
 
     def advance_frame(self) -> List:
         """Run the native advance/rollback decision; decode the request stream."""
+        # the native core does not export per-rollback blame, so LOAD decode
+        # below reconstructs lateness from the pre-advance frame and blames
+        # the unique remote handle when there is exactly one
+        cur_before = self.current_frame()
         n_req = C.c_int(0)
         n_in = C.c_int(0)
         rc = self._lib.ggrs_p2p_advance(
@@ -275,7 +295,18 @@ class NativeP2PSession:
                 requests.append(SaveRequest(frame, SaveCell(self, frame)))
                 i += 2
             elif t == 1:  # LOAD
-                requests.append(LoadRequest(int(words[i + 1])))
+                frame = int(words[i + 1])
+                blamed = (
+                    self._remote_handles[0]
+                    if len(self._remote_handles) == 1
+                    else "unknown"
+                )
+                requests.append(LoadRequest(frame, cause=RollbackCause(
+                    handle=blamed, frame=frame,
+                    lateness=max(0, cur_before - frame),
+                    mismatch=blamed != "unknown",
+                    kind="misprediction" if blamed != "unknown" else "unknown",
+                )))
                 i += 2
             else:  # ADVANCE
                 status = np.array(words[i + 2 : i + 2 + P], np.int8)
@@ -294,7 +325,11 @@ class NativeP2PSession:
         return out
 
     def network_stats(self, handle: int) -> NetworkStats:
-        """Ping/queue/kbps/frames-behind for a remote handle."""
+        """Ping/queue/kbps/frames-behind for a remote handle.
+
+        Local, unknown, and disconnected handles return a zeroed snapshot
+        with ``is_live=False`` instead of raising, so samplers can sweep
+        every handle without exception handling."""
         ping = C.c_double(0)
         q = C.c_int(0)
         kbps = C.c_double(0)
@@ -305,7 +340,7 @@ class NativeP2PSession:
             C.byref(lfb), C.byref(rfb),
         )
         if rc != _OK:
-            raise InvalidRequestError(f"no remote endpoint for handle {handle}")
+            return NetworkStats(is_live=False)
         return NetworkStats(
             ping_ms=ping.value, send_queue_len=q.value, kbps_sent=kbps.value,
             local_frames_behind=lfb.value, remote_frames_behind=rfb.value,

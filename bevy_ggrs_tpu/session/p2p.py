@@ -43,7 +43,13 @@ from .events import (
 )
 from .input_queue import InputQueue
 from .protocol import PeerEndpoint, now_s
-from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
+from .requests import (
+    AdvanceRequest,
+    LoadRequest,
+    RollbackCause,
+    SaveCell,
+    SaveRequest,
+)
 
 
 # absolute bound on un-acked send history (frames; ~68 s at 60 fps).  The
@@ -218,11 +224,16 @@ class P2PSession:
         return SessionState.SYNCHRONIZING
 
     def frames_ahead(self) -> int:
-        """Smoothed frames-ahead estimate driving run-slow."""
+        """Smoothed frames-ahead estimate driving run-slow.
+
+        Endpoints still warming up contribute 0: run-slow must not chase
+        the one-sided seed estimate (half local-only data) — that estimate
+        exists for the ``frame_advantage``/``time_sync_warmup`` gauges
+        (telemetry/netstats.py), not for the scheduler."""
         vals = [
             ep.time_sync.frames_ahead()
             for ep in self.endpoints.values()
-            if not ep.disconnected
+            if not ep.disconnected and ep.time_sync.warmed_up()
         ]
         return max(vals) if vals else 0
 
@@ -231,12 +242,34 @@ class P2PSession:
         out, self.events_buf = self.events_buf, []
         return out
 
+    def remote_player_handles(self) -> List[int]:
+        """Handles owned by remote peers, ascending (the sampler's walk
+        order — see telemetry/netstats.py)."""
+        return sorted(self.remote_handle_addr)
+
     def network_stats(self, handle: int) -> NetworkStats:
-        """Ping/queue/kbps/frames-behind for a remote handle."""
+        """Ping/queue/kbps/frames-behind for a remote handle.
+
+        Local, unknown, spectator, and disconnected handles return a zeroed
+        snapshot with ``is_live=False`` instead of raising, so periodic
+        samplers can walk every handle without exception churn or log spam."""
         addr = self.remote_handle_addr.get(handle)
         if addr is None or addr not in self.endpoints:
-            raise InvalidRequestError(f"no remote endpoint for handle {handle}")
-        return self.endpoints[addr].stats()
+            return NetworkStats(is_live=False)
+        ep = self.endpoints[addr]
+        if ep.disconnected:
+            return NetworkStats(is_live=False)
+        return ep.stats()
+
+    def time_sync_for(self, handle: int):
+        """The :class:`~bevy_ggrs_tpu.session.time_sync.TimeSync` tracker
+        behind a remote handle, or None for non-live handles (the sampler's
+        per-peer frame-advantage / warm-up source)."""
+        addr = self.remote_handle_addr.get(handle)
+        if addr is None or addr not in self.endpoints:
+            return None
+        ep = self.endpoints[addr]
+        return None if ep.disconnected else ep.time_sync
 
     # -- polling ------------------------------------------------------------
 
@@ -368,19 +401,31 @@ class P2PSession:
 
         requests: List = []
 
-        # rollback on misprediction
+        # rollback on misprediction — tracking WHOSE queue owns the earliest
+        # incorrect frame, so the LoadRequest carries the blamed handle
+        # (rollback-cause attribution; docs/observability.md "Network & QoS")
         first_incorrect = NULL_FRAME
-        for q in self.queues.values():
+        blamed_handle = None
+        blamed_mismatch = False
+        for h, q in self.queues.items():
             f = q.take_first_incorrect()
             if f != NULL_FRAME and (
                 first_incorrect == NULL_FRAME or frame_lt(f, first_incorrect)
             ):
                 first_incorrect = f
+                blamed_handle = h
+                blamed_mismatch = q.first_incorrect_mismatch
         rolled_back = False
         if first_incorrect != NULL_FRAME and frame_lt(
             first_incorrect, self.current_frame
         ):
-            requests.append(LoadRequest(first_incorrect))
+            requests.append(LoadRequest(first_incorrect, cause=RollbackCause(
+                handle=blamed_handle,
+                frame=first_incorrect,
+                lateness=frame_diff(self.current_frame, first_incorrect),
+                mismatch=blamed_mismatch,
+                kind="misprediction" if blamed_mismatch else "disconnect",
+            )))
             i = first_incorrect
             while i != self.current_frame:
                 inputs, status = self._inputs_for(i)
@@ -469,8 +514,10 @@ class P2PSession:
         ):
             # frames after f were advanced on richer inputs (or stale
             # predictions): the standard mismatch-rollback path replays
-            # them under the disconnect policy
+            # them under the disconnect policy (a structural truncation,
+            # not a served-prediction mismatch — attribution reads the flag)
             q.first_incorrect = nxt
+            q.first_incorrect_mismatch = False
         self._disc_notices[handle] = (f, now_s() + DISC_NOTICE_REBROADCAST_S)
 
     def _make_on_disc_notice(self, addr):

@@ -42,9 +42,36 @@ class SaveRequest:
 
 
 @dataclass
+class RollbackCause:
+    """Why a LoadRequest happened — the rollback-cause attribution payload.
+
+    ``handle`` is the blamed player handle (the queue whose earliest
+    mispredicted frame won the rollback-target minimum), or a string tag
+    for structural rollbacks: ``"resim"`` for SyncTest's per-tick
+    re-simulation, ``"unknown"`` when the core could not attribute (the
+    native decode path with multiple remote handles).  ``lateness`` is how
+    many frames behind the session's current frame the correcting input
+    arrived — the depth the blamed peer cost us.  ``mismatch`` is True when
+    the cause was a served-prediction/actual-input disagreement (as opposed
+    to a disconnect-consensus truncation or a structural resim)."""
+
+    handle: object = "unknown"
+    frame: int = 0
+    lateness: int = 0
+    mismatch: bool = False
+    kind: str = "misprediction"  # | "disconnect" | "resim" | "unknown"
+
+
+@dataclass
 class LoadRequest:
-    """LoadGameState: restore the ring snapshot for `frame`."""
+    """LoadGameState: restore the ring snapshot for `frame`.
+
+    ``cause`` carries the rollback-cause attribution when the session can
+    name it (None from legacy/replay paths; the driver then attributes the
+    rollback to handle ``"unknown"`` so ``rollback_cause_total`` summed over
+    handles always equals ``rollbacks_total``)."""
     frame: int
+    cause: Optional[RollbackCause] = None
 
 
 @dataclass

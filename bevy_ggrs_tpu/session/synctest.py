@@ -22,7 +22,13 @@ from .. import telemetry
 from ..snapshot.lazy import readback_queue
 from ..utils.frames import NULL_FRAME, frame_add, frame_diff
 from .events import InputStatus, InvalidRequestError, MismatchedChecksumError
-from .requests import AdvanceRequest, LoadRequest, SaveCell, SaveRequest
+from .requests import (
+    AdvanceRequest,
+    LoadRequest,
+    RollbackCause,
+    SaveCell,
+    SaveRequest,
+)
 
 
 class SyncTestSession:
@@ -124,7 +130,13 @@ class SyncTestSession:
         d = self.check_distance
         if d > 0 and self._age + 1 >= d:
             t = frame_add(f, 1 - d)
-            requests.append(LoadRequest(t))
+            # structural re-simulation, not a blamed peer: the cause tags
+            # the oracle itself so rollback_cause_total sums still cover
+            # every rollback without pinning SyncTest churn on a player
+            requests.append(LoadRequest(t, cause=RollbackCause(
+                handle="resim", frame=t, lateness=d, mismatch=False,
+                kind="resim",
+            )))
             i = t
             while i != frame_add(f, 1):
                 requests.append(AdvanceRequest(self._input_for(i), status))

@@ -15,7 +15,18 @@ WINDOW = 40  # frames of smoothing
 
 
 class TimeSync:
-    """Rolling-window frame-advantage smoothing (drives run-slow)."""
+    """Rolling-window frame-advantage smoothing (drives run-slow).
+
+    Warm-up semantics: before the first quality report lands, the remote
+    window is empty.  The old behavior returned 0 from :meth:`frames_ahead`
+    until BOTH windows had data — hiding real early-session skew behind a
+    value indistinguishable from "perfectly synced".  Now the remote mean
+    is seeded at 0 (the first ``note_remote`` replaces the seed), so a
+    locally-observed advantage shows through immediately, and
+    :meth:`warmed_up` lets dashboards (the ``time_sync_warmup`` gauge in
+    :mod:`bevy_ggrs_tpu.telemetry.netstats`) tell "synced" from "no data
+    yet".  Run-slow consumers (``P2PSession.frames_ahead``) gate on
+    :meth:`warmed_up` so the scheduler never chases the seed."""
     def __init__(self):
         self.local_adv: Deque[int] = deque(maxlen=WINDOW)
         self.remote_adv: Deque[int] = deque(maxlen=WINDOW)
@@ -26,6 +37,12 @@ class TimeSync:
     def note_remote(self, remote_advantage: int) -> None:
         self.remote_adv.append(remote_advantage)
 
+    def warmed_up(self) -> bool:
+        """True once both windows hold at least one real observation —
+        i.e. :meth:`frames_ahead` reflects two-sided data, not the zero
+        seed standing in for the remote's view."""
+        return bool(self.local_adv) and bool(self.remote_adv)
+
     def local_advantage(self) -> int:
         """Smoothed local frames-ahead of the peer."""
         if not self.local_adv:
@@ -33,9 +50,16 @@ class TimeSync:
         return round(sum(self.local_adv) / len(self.local_adv))
 
     def frames_ahead(self) -> int:
-        """Half the smoothed advantage difference: frames we should yield."""
-        if not self.local_adv or not self.remote_adv:
+        """Half the smoothed advantage difference: frames we should yield.
+
+        An empty remote window contributes a 0-advantage seed instead of
+        suppressing the estimate entirely (see class docstring)."""
+        if not self.local_adv:
             return 0
         l = sum(self.local_adv) / len(self.local_adv)
-        r = sum(self.remote_adv) / len(self.remote_adv)
+        r = (
+            sum(self.remote_adv) / len(self.remote_adv)
+            if self.remote_adv
+            else 0.0
+        )
         return round((l - r) / 2)

@@ -10,8 +10,13 @@ This package is the single replacement surface:
   ``speculation_hit_ratio``, ``checksum_mismatch_total``, ...).
 - :mod:`.timeline` — one ordered event stream per process merging the span
   ring, per-peer network stats and driver decisions; JSONL export.
-- :mod:`.forensics` — per-component checksum reports on desync.
-- :mod:`.prometheus` — HTTP ``/metrics`` exporter (room server).
+- :mod:`.forensics` — per-component checksum reports on desync, plus the
+  cross-peer ``merge_reports`` alignment.
+- :mod:`.netstats` — periodic per-peer NetworkStats/TimeSync sampler
+  (``peer_ping_ms``, ``frame_advantage``, ...; ``BGT_NETSTATS_EVERY``).
+- :mod:`.qos` — lobby health scoring (``lobby_qos_score``, the ``/qos``
+  endpoint payload).
+- :mod:`.prometheus` — HTTP ``/metrics`` + ``/qos`` exporter (room server).
 
 Everything is DISABLED by default and near-free while disabled; flip it on
 with :func:`enable` (or ``BGT_TELEMETRY=1`` in the environment).  Metric
@@ -32,6 +37,7 @@ from .forensics import (  # noqa: F401
     component_checksums,
     configure as configure_forensics,
     forensics_dir,
+    merge_reports,
     write_desync_report,
 )
 from .metrics import (  # noqa: F401
@@ -52,7 +58,9 @@ from .phases import (  # noqa: F401
     format_phase_table,
     phase_breakdown,
 )
+from .netstats import NetStatsSampler  # noqa: F401
 from .prometheus import MetricsExporter, start_http_exporter  # noqa: F401
+from .qos import qos_score, qos_snapshot, update_qos_gauges  # noqa: F401
 from .timeline import (  # noqa: F401
     Timeline,
     export_jsonl,
@@ -71,8 +79,9 @@ __all__ = [
     "registry", "timeline", "record", "export_jsonl", "span_sink",
     "count", "observe", "gauge_set", "percentile_from_buckets",
     "component_checksums", "configure_forensics", "forensics_dir",
-    "write_desync_report", "start_http_exporter",
+    "write_desync_report", "merge_reports", "start_http_exporter",
     "flight_recorder", "configure_flight", "dump_flight_record",
+    "NetStatsSampler", "qos_score", "qos_snapshot", "update_qos_gauges",
 ]
 
 

@@ -89,12 +89,16 @@ def write_desync_report(
     addr=None,
     lobby: Optional[int] = None,
     path: Optional[str] = None,
+    checksums: Optional[dict] = None,
 ) -> Optional[str]:
     """Dump a desync forensics report; returns the file path (or None when
     no directory is configured and no explicit ``path`` given).
 
     ``kind`` is ``"synctest_mismatch"`` or ``"p2p_desync"``; ``reg``/``world``
-    (when available) produce the per-component checksum section."""
+    (when available) produce the per-component checksum section.
+    ``checksums`` is the per-frame ``{frame: world_checksum}`` map the
+    session still holds — the alignment key :func:`merge_reports` uses to
+    find the first divergent frame across two peers' reports."""
     if path is None:
         d = _STATE["dir"]
         if d is None:
@@ -111,6 +115,11 @@ def write_desync_report(
         "remote_checksum": remote_checksum,
         "addr": repr(addr) if addr is not None else None,
         "lobby": lobby,
+        "checksums": (
+            {int(f): v for f, v in checksums.items()}
+            if checksums is not None
+            else None
+        ),
         "component_checksums": (
             component_checksums(reg, world)
             if reg is not None and world is not None
@@ -132,3 +141,98 @@ def write_desync_report(
         ).inc(kind=kind)
     _timeline.record("desync_report", report_kind=kind, path=path)
     return path
+
+
+def _frame_checksums(report: dict) -> dict:
+    """The report's per-frame checksum map with int frame keys (JSON
+    round-trips dict keys as strings)."""
+    out = {}
+    for k, v in (report.get("checksums") or {}).items():
+        try:
+            out[int(k)] = v
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def _flight_entries(report: dict, kind: str) -> list:
+    """Entries of one kind from the report's flight-record section."""
+    return [
+        e
+        for e in (report.get("flight_record") or [])
+        if isinstance(e, dict) and e.get("kind") == kind
+    ]
+
+
+def merge_reports(path_a: str, path_b: str) -> dict:
+    """Cross-peer forensics merge: align two peers' desync reports by frame
+    and localize the divergence (``replay_tool.py merge-reports``).
+
+    Frame-aligns both reports' per-frame checksum maps, finds the first
+    frame where both peers recorded a value and the values differ, diffs the
+    per-component checksum sections, and pulls each side's flight-recorder
+    context (tick entries around the divergent frame, every rollback
+    decision with its blamed handle).  Returns::
+
+        {"first_divergent_frame": int | None,
+         "common_frames": n, "divergent_frames": [f, ...],
+         "checksums_at_divergence": {"a": ..., "b": ...},
+         "component_diff": [name, ...] | None,
+         "rollbacks": {"a": [...], "b": [...]},
+         "tick_context": {"a": [...], "b": [...]}}
+
+    ``first_divergent_frame`` is None when the overlapping frames agree —
+    the divergence happened outside the retained checksum window (rerun
+    with a denser desync-detection interval; see
+    ``docs/debugging-desyncs.md`` §0)."""
+    with open(path_a) as f:
+        a = json.load(f)
+    with open(path_b) as f:
+        b = json.load(f)
+    cs_a, cs_b = _frame_checksums(a), _frame_checksums(b)
+    common = sorted(set(cs_a) & set(cs_b))
+    divergent = [f for f in common if cs_a[f] != cs_b[f]]
+    first = divergent[0] if divergent else None
+    if first is None:
+        # no overlapping per-frame data disagreed; fall back to the frames
+        # the detectors themselves flagged (present in both reports)
+        flagged = sorted(
+            set(a.get("frames") or []) & set(b.get("frames") or [])
+        )
+        first = flagged[0] if flagged else None
+    comp_diff = None
+    ca, cb = a.get("component_checksums"), b.get("component_checksums")
+    if ca and cb:
+        comp_diff = sorted(
+            name
+            for name in set(ca) | set(cb)
+            if ca.get(name) != cb.get(name)
+        )
+
+    def _context(rep: dict) -> list:
+        if first is None:
+            return _flight_entries(rep, "tick")[-8:]
+        return [
+            e
+            for e in _flight_entries(rep, "tick")
+            if e.get("frame") is not None and abs(e["frame"] - first) <= 4
+        ]
+
+    return {
+        "a": path_a,
+        "b": path_b,
+        "first_divergent_frame": first,
+        "common_frames": len(common),
+        "divergent_frames": divergent,
+        "checksums_at_divergence": (
+            {"a": cs_a.get(first), "b": cs_b.get(first)}
+            if first is not None
+            else None
+        ),
+        "component_diff": comp_diff,
+        "rollbacks": {
+            "a": _flight_entries(a, "rollback"),
+            "b": _flight_entries(b, "rollback"),
+        },
+        "tick_context": {"a": _context(a), "b": _context(b)},
+    }

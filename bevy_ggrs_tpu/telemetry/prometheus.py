@@ -10,10 +10,14 @@ registry to a Prometheus scraper with one call:
     exporter.close()
 
 The handler renders :meth:`MetricsRegistry.render_prometheus` per scrape —
-no caching, no extra thread work between scrapes."""
+no caching, no extra thread work between scrapes.  ``GET /qos`` serves the
+JSON lobby-health snapshot from :mod:`.qos` (schema documented in
+``docs/observability.md``), refreshing the ``lobby_qos_score`` gauges as a
+side effect so the next ``/metrics`` scrape carries them too."""
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -21,6 +25,7 @@ from typing import Optional
 from .metrics import MetricsRegistry, registry as _default_registry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+QOS_CONTENT_TYPE = "application/json; charset=utf-8"
 
 
 class MetricsExporter:
@@ -34,13 +39,21 @@ class MetricsExporter:
             """Per-scrape request handler (``/metrics`` + ``/`` index)."""
 
             def do_GET(self):  # noqa: N802 (stdlib naming)
-                """Serve the current exposition text."""
-                if self.path.split("?")[0] not in ("/metrics", "/"):
+                """Serve exposition text (``/metrics``) or QoS JSON (``/qos``)."""
+                path = self.path.split("?")[0]
+                if path == "/qos":
+                    from .qos import update_qos_gauges
+
+                    body = json.dumps(update_qos_gauges(reg)).encode("utf-8")
+                    ctype = QOS_CONTENT_TYPE
+                elif path in ("/metrics", "/"):
+                    body = reg.render_prometheus().encode("utf-8")
+                    ctype = CONTENT_TYPE
+                else:
                     self.send_error(404)
                     return
-                body = reg.render_prometheus().encode("utf-8")
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

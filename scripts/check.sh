@@ -29,9 +29,12 @@ g.dryrun_multichip(8)
 print("graft ok")
 EOF
 
-echo "== bench smoke (batched + sharded stages, O(1)-dispatch gates) =="
+echo "== bench smoke (batched + sharded + netstats stages, gates armed) =="
 # the sharded stage runs under forced 8-virtual-device CPU and hard-fails
-# unless per-device dispatches per tick are flat across lobby counts
+# unless per-device dispatches per tick are flat across lobby counts; the
+# netstats stage hard-fails unless every rollback carries a blamed handle
+# (sum(rollback_cause_total) == rollbacks_total), the sampler costs <1% of
+# the tick, and /qos serves a usable lobby_qos_score
 python bench.py --smoke
 
 echo "== bench =="

@@ -1,6 +1,8 @@
 #!/usr/bin/env python
-"""Fast lint gate for CI: unused imports, obvious bind errors, and the
-hot-loop purity rule.
+"""Fast lint gate for CI: unused imports, obvious bind errors, the
+hot-loop purity rule, the phase-timer catalog, and the metric-name <->
+docs-catalog cross-check (every registered metric must have a
+docs/observability.md table row, and vice versa).
 
 Prefers ``pyflakes`` when it is importable (full undefined-name analysis);
 otherwise falls back to a stdlib-``ast`` checker that catches the highest
@@ -19,6 +21,7 @@ only inside the allowlisted harvest/flush functions below.
 """
 
 import ast
+import re
 import sys
 from pathlib import Path
 
@@ -66,6 +69,112 @@ PHASE_CATALOG = {
     "readback_harvest", "rollback_load", "store_save",
 }
 PHASE_FILES = ("bevy_ggrs_tpu/runner.py", "bevy_ggrs_tpu/batch_runner.py")
+
+# -- metric-name <-> docs-catalog cross-check --------------------------------
+# Every metric the package/scripts register with a literal name must appear
+# in a `| metric | ... |` table of docs/observability.md, and every name the
+# docs catalog lists must still be registered somewhere — both directions,
+# so the catalog can neither rot nor silently under-document new families.
+# Tests are excluded (they register throwaway names on purpose).
+METRIC_CODE_PATHS = ("bevy_ggrs_tpu", "scripts", "bench.py")
+METRIC_DOCS = "docs/observability.md"
+# registry/shorthand entry points whose first positional arg is the name
+_METRIC_REG_ATTRS = {
+    "counter", "gauge", "histogram",
+    "bind_counter", "bind_gauge", "bind_histogram", "gauge_set",
+}
+# telemetry-module shorthands; gated on the receiver being `telemetry` so
+# unrelated `.count("x")` / `.observe(...)` methods never false-positive
+_METRIC_TELEMETRY_ATTRS = {"count", "observe", "gauge_set"}
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]{2,}$")
+
+
+def _attr_root(node: ast.Attribute):
+    """Name at the root of a dotted/called access, e.g. ``registry().x`` or
+    ``a.b.c`` -> ``registry`` / ``a`` (None when the root is not a name)."""
+    inner = node.value
+    while isinstance(inner, (ast.Attribute, ast.Call)):
+        inner = inner.func if isinstance(inner, ast.Call) else inner.value
+    return inner.id if isinstance(inner, ast.Name) else None
+
+
+def collect_metric_names(tree: ast.AST) -> set:
+    """Metric names registered with a string literal anywhere in ``tree``."""
+    names = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr in _METRIC_TELEMETRY_ATTRS:
+            if _attr_root(node.func) != "telemetry":
+                continue
+        elif attr not in _METRIC_REG_ATTRS:
+            continue
+        if not node.args:
+            continue
+        a0 = node.args[0]
+        # a conditional name picks one of two literals (runner.py's
+        # speculation hit/miss counter) — both are registered names
+        cands = [a0.body, a0.orelse] if isinstance(a0, ast.IfExp) else [a0]
+        for c in cands:
+            if isinstance(c, ast.Constant) and isinstance(c.value, str) \
+                    and _METRIC_NAME_RE.match(c.value):
+                names.add(c.value)
+    return names
+
+
+def docs_metric_names(md_text: str) -> set:
+    """Backticked names in the first column of every ``| metric | ... |``
+    table in the docs catalog."""
+    names = set()
+    in_table = False
+    for line in md_text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "metric":
+            in_table = True
+            continue
+        if in_table and not set(cells[0]) <= set("-: "):
+            names.update(re.findall(r"`([a-z][a-z0-9_]+)`", cells[0]))
+    return names
+
+
+def check_metric_docs(root: Path) -> list:
+    """Both-direction diff between code-registered metric names and the
+    docs/observability.md catalog; returns ``(path, message)`` problems."""
+    code_names = set()
+    for p in METRIC_CODE_PATHS:
+        for f in _iter_files([root / p]):
+            if "tests" in f.parts:
+                continue
+            try:
+                tree = ast.parse(f.read_text(), filename=str(f))
+            except SyntaxError:
+                continue  # the import lint reports it
+            code_names |= collect_metric_names(tree)
+    docs_path = root / METRIC_DOCS
+    if not docs_path.exists():
+        return [(str(docs_path), "metric catalog file missing")]
+    doc_names = docs_metric_names(docs_path.read_text())
+    problems = []
+    for name in sorted(code_names - doc_names):
+        problems.append((
+            str(docs_path),
+            f"metric {name!r} is registered in code but missing from the "
+            "docs catalog (add a `| metric | labels | meaning |` row)",
+        ))
+    for name in sorted(doc_names - code_names):
+        problems.append((
+            str(docs_path),
+            f"metric {name!r} is documented in the catalog but never "
+            "registered in code (stale row — remove or fix the name)",
+        ))
+    return problems
 
 
 def _purity_allowlist(path: Path):
@@ -265,13 +374,16 @@ def main(argv) -> int:
         for lineno, msg in _check_phases_file(f):
             print(f"{f}:{lineno}: {msg}")
             pure_bad += 1
+    for where, msg in check_metric_docs(Path(__file__).resolve().parent.parent):
+        print(f"{where}: {msg}")
+        pure_bad += 1
     try:
         from pyflakes.api import checkPath
         from pyflakes.reporter import Reporter
 
         rep = Reporter(sys.stdout, sys.stderr)
         bad = sum(checkPath(str(f), rep) for f in files)
-        print(f"lint (pyflakes + purity + phases): {len(files)} files, "
+        print(f"lint (pyflakes + purity + phases + metrics): {len(files)} files, "
               f"{bad + pure_bad} problems")
         return 1 if bad + pure_bad else 0
     except ImportError:
@@ -281,7 +393,7 @@ def main(argv) -> int:
         for lineno, msg in _check_file(f):
             print(f"{f}:{lineno}: {msg}")
             bad += 1
-    print(f"lint (stdlib ast + purity + phases): {len(files)} files, "
+    print(f"lint (stdlib ast + purity + phases + metrics): {len(files)} files, "
           f"{bad + pure_bad} problems")
     return 1 if bad + pure_bad else 0
 

@@ -4,12 +4,16 @@
     python scripts/replay_tool.py info match.npz
     python scripts/replay_tool.py checksums match.npz --model box_game [--every 10]
     python scripts/replay_tool.py diff a.npz b.npz
+    python scripts/replay_tool.py merge-reports peer_a.json peer_b.json
 
 `checksums` re-simulates the recording deterministically and prints per-frame
 checksums (compare outputs across builds/machines to locate a divergence
 frame); `diff` compares two recordings' input streams (e.g. the two peers'
 recordings of the same match — the first differing frame is where their
-realities split)."""
+realities split); `merge-reports` frame-aligns two peers' desync forensics
+reports (telemetry/forensics.py JSON files) and prints the first divergent
+frame with both sides' rollback and phase context — run it FIRST, before
+any re-simulation (docs/debugging-desyncs.md §0)."""
 
 import argparse
 import sys
@@ -94,6 +98,54 @@ def cmd_diff(args):
     return 1 if diverged else 0
 
 
+def cmd_merge_reports(args):
+    from bevy_ggrs_tpu.telemetry import merge_reports
+
+    m = merge_reports(args.a, args.b)
+    first = m["first_divergent_frame"]
+    print(f"a: {m['a']}")
+    print(f"b: {m['b']}")
+    print(f"overlapping checksummed frames: {m['common_frames']}")
+    if first is None:
+        print("no divergent frame in the overlapping window — the split "
+              "predates both reports' retained checksums; rerun with a "
+              "denser DesyncDetection interval")
+        return 0
+    at = m["checksums_at_divergence"] or {}
+    print(f"FIRST DIVERGENT FRAME: {first}")
+    if at.get("a") is not None or at.get("b") is not None:
+        print(f"  checksum a: {at.get('a'):#018x}" if at.get("a") is not None
+              else "  checksum a: (absent)")
+        print(f"  checksum b: {at.get('b'):#018x}" if at.get("b") is not None
+              else "  checksum b: (absent)")
+    if m["divergent_frames"]:
+        tail = m["divergent_frames"][:8]
+        print(f"  divergent frames ({len(m['divergent_frames'])}): {tail}"
+              + (" ..." if len(m["divergent_frames"]) > 8 else ""))
+    if m["component_diff"]:
+        print(f"  diverged components: {', '.join(m['component_diff'])}")
+    for side in ("a", "b"):
+        rbs = [r for r in m["rollbacks"][side]
+               if r.get("to_frame") is not None
+               and abs(r["to_frame"] - first) <= 8]
+        if rbs:
+            print(f"  {side} rollbacks near frame {first}:")
+            for r in rbs[-4:]:
+                print(f"    -> {r.get('to_frame')} depth={r.get('depth')} "
+                      f"handle={r.get('handle')} "
+                      f"lateness={r.get('lateness')} "
+                      f"kind={r.get('cause_kind')}")
+        ctx = m["tick_context"][side]
+        if ctx:
+            print(f"  {side} tick context ({len(ctx)} entries):")
+            for e in ctx[-4:]:
+                print(f"    frame={e.get('frame')} "
+                      f"wall_ms={e.get('wall_ms')} "
+                      f"rollbacks={e.get('rollbacks')} "
+                      f"phases={e.get('phases')}")
+    return 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -113,8 +165,19 @@ def main():
     p = sub.add_parser("diff")
     p.add_argument("a")
     p.add_argument("b")
+    p = sub.add_parser(
+        "merge-reports",
+        help="frame-align two desync forensics reports; exit 1 on divergence",
+    )
+    p.add_argument("a")
+    p.add_argument("b")
     args = ap.parse_args()
-    rc = {"info": cmd_info, "checksums": cmd_checksums, "diff": cmd_diff}[args.cmd](args)
+    rc = {
+        "info": cmd_info,
+        "checksums": cmd_checksums,
+        "diff": cmd_diff,
+        "merge-reports": cmd_merge_reports,
+    }[args.cmd](args)
     raise SystemExit(rc or 0)
 
 

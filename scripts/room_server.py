@@ -6,7 +6,9 @@ cannot reach each other directly.
     python scripts/room_server.py --port 3536
 
 With ``--metrics-port`` the process also serves the telemetry registry as a
-Prometheus text endpoint (``GET /metrics`` — see docs/observability.md):
+Prometheus text endpoint (``GET /metrics``) plus the lobby QoS snapshot as
+JSON (``GET /qos`` — see docs/observability.md "Network & QoS"); the
+``lobby_qos_score`` gauges are refreshed in the 5 s reporting loop:
 
     python scripts/room_server.py --port 3536 --metrics-port 9464
 """
@@ -46,6 +48,10 @@ def main() -> None:
             f"metrics on http://{args.metrics_host}:{exporter.port}/metrics",
             flush=True,
         )
+        print(
+            f"qos on http://{args.metrics_host}:{exporter.port}/qos",
+            flush=True,
+        )
     server = RoomServer(port=args.port, host=args.host,
                         member_timeout_s=args.timeout,
                         join_token=args.join_token)
@@ -67,6 +73,9 @@ def main() -> None:
                     sum(len(m) for m in rooms.values()),
                     "members across all rooms",
                 )
+                # keep the lobby_qos_score gauges warm for /metrics scrapes
+                # (/qos recomputes on demand either way)
+                telemetry.update_qos_gauges()
                 if rooms:
                     print(f"rooms: {rooms}", flush=True)
             time.sleep(0.002)

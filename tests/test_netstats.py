@@ -1,0 +1,394 @@
+"""Network-layer observability: NetStatsSampler, rollback-cause
+attribution, QoS scoring, and cross-peer forensics merge
+(telemetry/netstats.py, telemetry/qos.py, forensics.merge_reports)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    telemetry,
+)
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.session.channel import ChannelNetwork
+from bevy_ggrs_tpu.session.events import NetworkStats
+from bevy_ggrs_tpu.session.requests import LoadRequest
+from bevy_ggrs_tpu.session.synctest import SyncTestSession
+from bevy_ggrs_tpu.session.time_sync import TimeSync
+from bevy_ggrs_tpu.telemetry.netstats import NetStatsSampler
+from bevy_ggrs_tpu.telemetry.qos import qos_score, qos_snapshot
+
+DT = 1.0 / 60.0
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+class _FakeSession:
+    """Minimal session surface for sampler unit tests."""
+
+    def __init__(self, stats_by_handle):
+        self.stats_by_handle = stats_by_handle
+        self.calls = 0
+
+    def remote_player_handles(self):
+        return sorted(self.stats_by_handle)
+
+    def network_stats(self, handle):
+        self.calls += 1
+        return self.stats_by_handle[handle]
+
+    def frames_ahead(self):
+        return 2
+
+
+# -- sampler ----------------------------------------------------------------
+
+
+def test_sampler_disabled_is_one_boolean_check():
+    s = _FakeSession({1: NetworkStats(ping_ms=10.0)})
+    sampler = NetStatsSampler(s, every=0)
+    assert not sampler.enabled
+    for _ in range(100):
+        sampler.poll()
+    # the disabled path returns before even counting polls: no counter
+    # bump, no session traffic, no registry traffic
+    assert sampler._n == 0
+    assert s.calls == 0
+    assert sampler.samples == 0
+    assert "netstats_samples_total" not in telemetry.registry().snapshot()
+
+
+def test_sampler_cadence_and_families():
+    s = _FakeSession({
+        1: NetworkStats(ping_ms=42.0, send_queue_len=3, kbps_sent=8.5,
+                        local_frames_behind=2, remote_frames_behind=-1),
+    })
+    sampler = NetStatsSampler(s, every=5)
+    for _ in range(25):
+        sampler.poll()
+    assert sampler.samples == 5
+    snap = telemetry.registry().snapshot()
+    assert snap["peer_send_queue"]["series"]["handle=1"] == 3
+    assert snap["peer_kbps"]["series"]["handle=1"] == 8.5
+    behind = snap["peer_frames_behind"]["series"]
+    assert behind["handle=1,side=local"] == 2
+    assert behind["handle=1,side=remote"] == -1
+    # no per-endpoint TimeSync on the fake: falls back to session-wide
+    # frames_ahead, warmup reads 0 (treated as warmed)
+    assert snap["frame_advantage"]["series"]["handle=1"] == 2
+    assert snap["time_sync_warmup"]["series"]["handle=1"] == 0
+    ping = snap["peer_ping_ms"]["series"]["handle=1"]
+    assert ping["count"] == 5 and ping["sum"] == pytest.approx(5 * 42.0)
+    assert snap["netstats_samples_total"]["series"][""] == 5
+
+
+def test_sampler_skips_non_live_silently():
+    s = _FakeSession({
+        0: NetworkStats(is_live=False),
+        1: NetworkStats(ping_ms=5.0),
+    })
+    sampler = NetStatsSampler(s, every=1)
+    sampler.poll()
+    series = telemetry.registry().snapshot()["peer_ping_ms"]["series"]
+    assert "handle=1" in series and "handle=0" not in series
+
+
+def test_sampler_env_cadence(monkeypatch):
+    monkeypatch.setenv("BGT_NETSTATS_EVERY", "7")
+    assert NetStatsSampler(_FakeSession({})).every == 7
+    monkeypatch.setenv("BGT_NETSTATS_EVERY", "0")
+    assert not NetStatsSampler(_FakeSession({})).enabled
+    monkeypatch.setenv("BGT_NETSTATS_EVERY", "junk")
+    assert NetStatsSampler(_FakeSession({})).every == 60
+
+
+# -- zeroed NetworkStats (is_live) ------------------------------------------
+
+
+def _p2p_pair(latency_hops=0, seed=1, delay=1):
+    net = ChannelNetwork(latency_hops=latency_hops, seed=seed)
+    socks = [net.endpoint("peer0"), net.endpoint("peer1")]
+    runners = []
+    for i in range(2):
+        app = box_game.make_app(num_players=2)
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(delay)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"peer{1 - i}")
+        )
+        session = b.start_p2p_session(socks[i])
+        runners.append(
+            GgrsRunner(app, session, read_inputs=lambda hs: {
+                h: box_game.keys_to_input() for h in hs
+            })
+        )
+    return net, runners
+
+
+def _sync(net, runners, ticks=300):
+    for _ in range(ticks):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+    assert all(
+        r.session.current_state() == SessionState.RUNNING for r in runners
+    )
+
+
+def test_network_stats_zeroed_for_non_live_handles():
+    net, runners = _p2p_pair()
+    s = runners[0].session
+    # local handle: no endpoint behind it -> zeroed, not an exception
+    st = s.network_stats(0)
+    assert not st.is_live and st.ping_ms == 0.0 and st.send_queue_len == 0
+    # unknown handle
+    assert not s.network_stats(99).is_live
+    # live remote handle
+    assert s.network_stats(1).is_live
+    # disconnected endpoint -> back to zeroed
+    addr = s.remote_handle_addr[1]
+    s.endpoints[addr].disconnected = True
+    assert not s.network_stats(1).is_live
+    assert s.time_sync_for(1) is None
+    assert s.remote_player_handles() == [1]
+
+
+# -- rollback-cause attribution ---------------------------------------------
+
+
+def test_p2p_attribution_blames_remote_and_sums_match():
+    net, runners = _p2p_pair(latency_hops=3)
+    _sync(net, runners)
+    flip = [0]
+
+    def read_inputs(handles):
+        flip[0] += 1
+        on = (flip[0] // 7) % 2 == 0
+        return {h: box_game.keys_to_input(right=on) for h in handles}
+
+    for r in runners:
+        r.read_inputs = read_inputs
+        r._netstats = NetStatsSampler(r.session, every=8)
+    for _ in range(120):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+    snap = telemetry.registry().snapshot()
+    total = sum(snap["rollbacks_total"]["series"].values())
+    causes = snap["rollback_cause_total"]["series"]
+    assert total > 0, "latency + flipping inputs must force rollbacks"
+    # the attribution invariant: every rollback carries a cause
+    assert sum(causes.values()) == total
+    # p2p mispredictions blame the REMOTE peer (each runner blames the
+    # other's handle — both appear because both processes share a registry)
+    assert set(causes) <= {"handle=0", "handle=1"}
+    # lateness histogram rides the same labels
+    lat = snap["input_lateness_frames"]["series"]
+    assert sum(v["count"] for v in lat.values()) == total
+    assert all(v["sum"] >= v["count"] for v in lat.values())  # >= 1 frame late
+    # the sampler populated the per-peer families along the way
+    assert "peer_ping_ms" in snap and "netstats_samples_total" in snap
+    # flight ring carries the blamed entries even for always-on consumers
+    rb_entries = telemetry.flight_recorder().snapshot("rollback")
+    assert rb_entries and all(
+        e.get("handle") in (0, 1) and e.get("lateness", 0) >= 1
+        for e in rb_entries
+    )
+
+
+def test_synctest_rollbacks_attributed_as_resim():
+    s = SyncTestSession(num_players=1, check_distance=2)
+    causes = []
+    for _ in range(6):
+        s.add_local_input(0, np.uint8(0))
+        for r in s.advance_frame():
+            if isinstance(r, LoadRequest):
+                causes.append(r.cause)
+    assert causes, "check_distance>0 must emit structural rollbacks"
+    for c in causes:
+        assert c is not None
+        assert c.handle == "resim" and c.kind == "resim"
+        assert c.lateness == 2 and not c.mismatch
+
+
+def test_causeless_load_attributed_to_unknown():
+    net, runners = _p2p_pair()
+    _sync(net, runners)
+    r = runners[0]
+    for _ in range(4):
+        net.deliver()
+        for x in runners:
+            x.update(DT)
+    target = max(r.ring.frames())
+    r._load(target, None)  # legacy/replay path: no cause attached
+    snap = telemetry.registry().snapshot()
+    causes = snap["rollback_cause_total"]["series"]
+    total = sum(snap["rollbacks_total"]["series"].values())
+    assert causes.get("handle=unknown", 0) >= 1
+    assert sum(causes.values()) == total
+
+
+# -- TimeSync warmup ---------------------------------------------------------
+
+
+def test_time_sync_warmup_and_one_sided_estimate():
+    ts = TimeSync()
+    assert not ts.warmed_up()
+    assert ts.frames_ahead() == 0  # no data at all
+    for f in range(10):
+        ts.note_local(f + 4, f)  # consistently 4 ahead locally
+    assert not ts.warmed_up()  # remote window still empty...
+    assert ts.frames_ahead() == 2  # ...but the local view shows through
+    ts.note_remote(-4)
+    assert ts.warmed_up()
+    assert ts.frames_ahead() == 4  # (4 - (-4)) / 2
+
+
+# -- QoS ---------------------------------------------------------------------
+
+
+def test_qos_score_monotone_and_bounded():
+    base = qos_score(0, 0, 0, 0)
+    assert base == 100.0
+    # strictly monotone decreasing along every axis, from any point
+    pts = [(0, 0, 0, 0), (60, 0.1, 0.01, 10.0), (300, 1.0, 0.5, 100.0)]
+    for p in pts:
+        s0 = qos_score(*p)
+        for axis in range(4):
+            worse = list(p)
+            worse[axis] = worse[axis] * 2 + 1
+            assert qos_score(*worse) < s0
+        assert 0.0 < s0 <= 100.0
+    # negative (bogus) samples clamp instead of inflating the score
+    assert qos_score(-50, 0, 0, 0) == 100.0
+
+
+def test_qos_snapshot_reads_registry_and_serves_json():
+    import urllib.request
+
+    telemetry.count("rollbacks_total", 5)
+    telemetry.count("ticks_total", 100)
+    telemetry.count("readback_forced_total", 1)
+    telemetry.count("readback_harvested_total", 9)
+    snap = qos_snapshot()
+    d = snap["lobbies"]["default"]
+    assert d["inputs"]["rollback_rate"] == pytest.approx(0.05)
+    assert d["inputs"]["forced_readback_rate"] == pytest.approx(0.1)
+    assert 0 < d["score"] < 100
+    ex = telemetry.start_http_exporter(port=0)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/qos", timeout=10
+        ).read()
+        served = json.loads(body)
+        assert served["lobby_qos_score"]["default"] == d["score"]
+        assert served["scales"]["worst_ping_ms"] > 0
+        # the endpoint refreshed the gauge for the next /metrics scrape
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/metrics", timeout=10
+        ).read().decode()
+        assert "lobby_qos_score" in text
+    finally:
+        ex.close()
+
+
+def test_qos_per_lobby_scores():
+    telemetry.count("ticks_total", 100)
+    telemetry.count("rollbacks_total", 2, lobby=0)
+    telemetry.count("rollbacks_total", 40, lobby=1)
+    snap = qos_snapshot()
+    assert set(snap["lobby_qos_score"]) == {"0", "1"}
+    assert snap["lobby_qos_score"]["0"] > snap["lobby_qos_score"]["1"]
+
+
+# -- cross-peer forensics merge ----------------------------------------------
+
+
+def _write_report(tmp_path, name, checksums, comp, flight):
+    p = tmp_path / name
+    telemetry.write_desync_report(
+        "p2p_desync", frames=[max(checksums)], path=str(p),
+        checksums=checksums,
+    )
+    rep = json.loads(p.read_text())
+    rep["component_checksums"] = comp
+    rep["flight_record"] = flight
+    p.write_text(json.dumps(rep))
+    return str(p)
+
+
+def test_merge_reports_first_divergent_frame(tmp_path):
+    a = _write_report(
+        tmp_path, "a.json",
+        {8: 100, 9: 101, 10: 102, 11: 103},
+        {"position": 1, "velocity": 2},
+        [{"kind": "tick", "frame": 9, "wall_ms": 1.5},
+         {"kind": "rollback", "to_frame": 9, "depth": 2, "handle": 1,
+          "lateness": 2, "cause_kind": "misprediction"}],
+    )
+    b = _write_report(
+        tmp_path, "b.json",
+        {9: 101, 10: 999, 11: 998, 12: 997},
+        {"position": 1, "velocity": 7},
+        [{"kind": "tick", "frame": 10, "wall_ms": 1.1}],
+    )
+    m = telemetry.merge_reports(a, b)
+    assert m["first_divergent_frame"] == 10
+    assert m["divergent_frames"] == [10, 11]
+    assert m["common_frames"] == 3  # frames 9, 10, 11
+    assert m["checksums_at_divergence"] == {"a": 102, "b": 999}
+    assert m["component_diff"] == ["velocity"]
+    assert m["rollbacks"]["a"][0]["handle"] == 1
+    # tick context windows around the divergent frame
+    assert [e["frame"] for e in m["tick_context"]["a"]] == [9]
+    assert [e["frame"] for e in m["tick_context"]["b"]] == [10]
+
+
+def test_merge_reports_agreeing_windows(tmp_path):
+    cs = {5: 1, 6: 2}
+    a = _write_report(tmp_path, "a.json", cs, None, [])
+    b = _write_report(tmp_path, "b.json", cs, None, [])
+    m = telemetry.merge_reports(a, b)
+    # overlap agrees -> fall back to the detector-flagged frames (both
+    # reports flagged max(cs) here)
+    assert m["first_divergent_frame"] == 6
+    assert m["divergent_frames"] == []
+
+
+def test_desync_report_carries_frame_checksums(tmp_path):
+    p = tmp_path / "r.json"
+    telemetry.write_desync_report(
+        "p2p_desync", frames=[3], path=str(p), checksums={3: 7, 4: 8},
+    )
+    rep = json.loads(p.read_text())
+    assert rep["checksums"] == {"3": 7, "4": 8}
+
+
+def test_merge_reports_cli(tmp_path, capsys):
+    import scripts.replay_tool as rt
+
+    a = _write_report(tmp_path, "a.json", {1: 10, 2: 20}, None, [])
+    b = _write_report(tmp_path, "b.json", {1: 10, 2: 21}, None, [])
+
+    class Args:
+        pass
+
+    args = Args()
+    args.a, args.b = a, b
+    rc = rt.cmd_merge_reports(args)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "FIRST DIVERGENT FRAME: 2" in out
