@@ -3,8 +3,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== lint (unused imports + hot-loop purity) =="
-python scripts/lint_imports.py
+echo "== determinism analyzer (hard gate; JSON report next to bench artifacts) =="
+# 19 rules: hygiene, intra- + interprocedural hot-loop purity, phase-timer
+# discipline, metric/rule docs cross-checks, determinism hazards — see
+# docs/static-analysis.md; scripts/lint_imports.py remains as a thin shim
+python -m scripts.lint --json LINT_report.json
 
 echo "== native build + tests =="
 make -C native

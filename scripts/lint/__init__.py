@@ -1,0 +1,26 @@
+"""bevy_ggrs_tpu determinism analyzer — a stdlib-only static-analysis
+framework with stable ``BGT0xx`` rule ids.
+
+Usage::
+
+    python -m scripts.lint [paths...] [--json FILE] [--baseline FILE]
+    python -m scripts.lint --list-rules
+
+The rule catalog lives in docs/static-analysis.md (cross-checked against
+the registry in both directions by rule BGT050/BGT051).  Suppress a finding
+with a ``bgt: ignore`` comment naming the rule id, on (or directly above)
+the offending line — see the docs for the exact syntax.
+
+``scripts/lint_imports.py`` is kept as a thin shim over this package so
+pre-existing invocations and the test-suite mirrors keep working.
+"""
+
+from .core import (  # noqa: F401
+    DEFAULT_PATHS,
+    Finding,
+    Rule,
+    RULES,
+    main,
+    run,
+)
+from .config import Config  # noqa: F401

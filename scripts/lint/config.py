@@ -1,0 +1,104 @@
+"""Analysis configuration — the repo's allowlists and scopes in one place.
+
+Fixture tests construct a :class:`Config` pointing at the corpus under
+``tests/lint_fixtures/`` instead of the real drivers; everything else uses
+the defaults below.  The allowlists themselves are meta-linted (``BGT012``:
+every allowlisted function must still exist in its target file) so they
+cannot rot silently when drivers are refactored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Set, Tuple
+
+# -- hot-loop purity ---------------------------------------------------------
+# file (repo-relative posix suffix) -> functions allowed to force
+# device->host reads.  These are the sanctioned flush funnels: calling one
+# from hot-loop code is fine (that is their job); forcing *syntax* anywhere
+# else in these files — or reaching a forcing helper through a call chain
+# (BGT011) — is not.
+PURITY_ALLOW: Dict[str, Set[str]] = {
+    "bevy_ggrs_tpu/runner.py": {
+        "checksum",               # user-facing flush point (property)
+        "read_components",        # render readback (drains first)
+        "_drain_inflight",        # THE blocking point the others share
+        "_flush_session_checks",  # finish()/set_session flush
+    },
+    "bevy_ggrs_tpu/batch_runner.py": {
+        "lobby_checksum",         # user-facing flush point
+        "finish",                 # end-of-run flush
+    },
+    "bevy_ggrs_tpu/ops/batch.py": {
+        "harvest_shards",         # per-device metrics probe (bench/dryrun
+                                  # only — never called from the tick path)
+    },
+    "bevy_ggrs_tpu/session/p2p.py": {
+        "check_now",              # finish()/set_session flush hook
+        "_resolve_checksum",      # the one sanctioned force/peek funnel
+    },
+}
+
+# attribute accesses that force (or can force) a device sync
+PURITY_ATTRS = frozenset({"to_int", "block_until_ready", "device_get"})
+# bare-name calls that force
+PURITY_NAMES = frozenset({"checksum_to_int"})
+
+# the package whose call graph the interprocedural pass builds
+PACKAGE_DIR = "bevy_ggrs_tpu"
+
+# -- tick-phase timer discipline ---------------------------------------------
+# The catalog itself is extracted from telemetry/phases.py by AST literal
+# parsing (no jax import) — see rules_phases.extract_phase_catalog.
+PHASES_MODULE = "bevy_ggrs_tpu/telemetry/phases.py"
+PHASE_FILES: Tuple[str, ...] = (
+    "bevy_ggrs_tpu/runner.py",
+    "bevy_ggrs_tpu/batch_runner.py",
+)
+
+# -- metric-name <-> docs-catalog cross-check --------------------------------
+METRIC_DOCS = "docs/observability.md"
+
+# -- rule-id <-> docs-catalog cross-check ------------------------------------
+RULE_DOCS = "docs/static-analysis.md"
+
+# -- determinism-hazard scopes -----------------------------------------------
+# step/sim code: the only places wall-clock reads, jitted debug callbacks
+# and frozen-world mutation are hazards *by construction* (session code
+# legitimately reads monotonic clocks for timeouts — host-side only)
+SIM_DIR_NAMES = frozenset({"models", "ops"})
+
+
+def _in_sim_code(rel: str) -> bool:
+    from pathlib import PurePosixPath
+
+    return bool(SIM_DIR_NAMES & set(PurePosixPath(rel).parts))
+
+
+@dataclasses.dataclass
+class Config:
+    """Overridable analysis configuration (defaults = this repo)."""
+
+    purity_allow: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=lambda: {k: set(v) for k, v in PURITY_ALLOW.items()}
+    )
+    purity_attrs: frozenset = PURITY_ATTRS
+    purity_names: frozenset = PURITY_NAMES
+    package_dir: str = PACKAGE_DIR
+    phases_module: str = PHASES_MODULE
+    phase_files: Tuple[str, ...] = PHASE_FILES
+    metric_docs: str = METRIC_DOCS
+    rule_docs: str = RULE_DOCS
+    # project-level cross-checks (metrics/docs/stale-allowlist) only make
+    # sense against the real repo; fixture runs turn them off
+    project_checks: bool = True
+
+    def purity_allowlist_for(self, rel: str):
+        """The allowlist for ``rel`` if the purity rules cover it, else None."""
+        for suffix, allow in self.purity_allow.items():
+            if rel.endswith(suffix):
+                return allow
+        return None
+
+    def in_sim_code(self, rel: str) -> bool:
+        return _in_sim_code(rel)

@@ -1,0 +1,374 @@
+"""Determinism-analyzer core: rule registry, findings, suppressions, baseline.
+
+The framework is stdlib-only by design (the container forbids installs) and
+never imports the package under analysis — everything is AST + text, so the
+gate runs in milliseconds and cannot be poisoned by import-time side effects
+(jax initialisation, device probes).
+
+Concepts
+--------
+Rule
+    A registered check with a stable ``BGT0xx`` id, a severity, and a
+    one-line summary.  Rules are declared with :func:`rule` so the registry
+    is the single source of truth — ``docs/static-analysis.md`` is
+    cross-checked against it in both directions (rule ``BGT050``/``BGT051``),
+    the same way the metric catalog lint works.
+
+Pass
+    A function that inspects the corpus and emits :class:`Finding`\\ s for
+    one or more rules.  Passes are registered with :func:`lint_pass`; a pass
+    sees the whole :class:`Context` so interprocedural analyses (the purity
+    call graph) are first-class, not bolted on.
+
+Suppression
+    ``# bgt: ignore[BGT041]`` on the offending line (or on a comment line
+    directly above it) waives that rule there.  A reason is encouraged:
+    ``# bgt: ignore[BGT041]: handshake nonce, host-side only``.  Unknown
+    rule ids inside an ignore comment are themselves a finding (``BGT004``)
+    so typos cannot silently disable a gate.
+
+Baseline
+    ``--baseline FILE`` loads fingerprints (rule, path, message — line
+    numbers excluded so pure line drift does not churn it) that are reported
+    as suppressed instead of failing the gate; ``--write-baseline`` emits
+    the file.  The repo itself carries **no** baseline: HEAD lints clean,
+    and the knob exists for downstream forks adopting the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+# directories never expanded when walking a path argument; the fixture
+# corpus *must* trip rules, so it is only ever linted via explicit paths
+# from the tests
+EXCLUDE_DIR_NAMES = {"__pycache__", "lint_fixtures", ".git"}
+
+DEFAULT_PATHS = ("bevy_ggrs_tpu", "tests", "scripts", "bench.py")
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered check with a stable id."""
+
+    id: str
+    name: str
+    severity: str
+    summary: str
+
+
+RULES: Dict[str, Rule] = {}
+PASSES: List[Callable] = []
+
+_RULE_ID_RE = re.compile(r"^BGT0\d\d$")
+
+
+def rule(id: str, name: str, severity: str = "error", summary: str = "") -> Rule:
+    """Register a rule id; returns the :class:`Rule` (import-time use)."""
+    if not _RULE_ID_RE.match(id):
+        raise ValueError(f"rule id {id!r} must match BGT0xx")
+    if id in RULES:
+        raise ValueError(f"duplicate rule id {id}")
+    if severity not in SEVERITIES:
+        raise ValueError(f"severity {severity!r} not in {SEVERITIES}")
+    r = Rule(id=id, name=name, severity=severity, summary=summary)
+    RULES[id] = r
+    return r
+
+
+def lint_pass(fn: Callable) -> Callable:
+    """Decorator: register ``fn(ctx) -> list[Finding]`` as an analysis pass."""
+    PASSES.append(fn)
+    return fn
+
+
+@dataclasses.dataclass
+class Finding:
+    """One problem at one place.  ``fingerprint`` (rule, path, message)
+    deliberately omits the line number so baselines survive unrelated
+    edits above the finding."""
+
+    rule: str
+    path: str  # repo-root-relative posix path
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule].severity
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "name": RULES[self.rule].name,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+        }
+
+
+# -- suppression comments -----------------------------------------------------
+
+_IGNORE_RE = re.compile(r"#\s*bgt:\s*ignore\[([A-Za-z0-9_,\s]+)\](?::\s*(.*))?")
+
+
+def parse_suppressions(src: str):
+    """Map ``line -> {rule_id: reason}`` for every ``# bgt: ignore[...]``
+    comment, plus ``(line, bad_id)`` pairs for unknown rule ids.
+
+    A suppression covers its own physical line; when the comment is the
+    *whole* line (a standalone comment), it extends through the rest of
+    that comment block to the first code line below it, so a multi-line
+    justification can sit above a long statement."""
+    covers: Dict[int, Dict[str, str]] = {}
+    unknown: List[Tuple[int, str]] = []
+    lines = src.splitlines()
+    for lineno, line in enumerate(lines, start=1):
+        m = _IGNORE_RE.search(line)
+        if not m:
+            continue
+        reason = (m.group(2) or "").strip()
+        ids = [t.strip() for t in m.group(1).split(",") if t.strip()]
+        targets = [lineno]
+        if line.strip().startswith("#"):
+            # cover the comment block below plus the first code line
+            nxt = lineno + 1
+            while nxt <= len(lines) and lines[nxt - 1].strip().startswith("#"):
+                targets.append(nxt)
+                nxt += 1
+            targets.append(nxt)
+        for rid in ids:
+            if rid not in RULES:
+                unknown.append((lineno, rid))
+                continue
+            for t in targets:
+                covers.setdefault(t, {})[rid] = reason
+    return covers, unknown
+
+
+# -- corpus -------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed file of the corpus."""
+
+    path: Path  # absolute
+    rel: str  # repo-root-relative posix
+    source: str
+    tree: Optional[ast.AST]  # None on syntax error
+    syntax_error: Optional[Tuple[int, str]]
+    suppressions: Dict[int, Dict[str, str]]
+    unknown_ignores: List[Tuple[int, str]]
+
+    @property
+    def is_test(self) -> bool:
+        parts = Path(self.rel).parts
+        return "tests" in parts and "lint_fixtures" not in parts
+
+    @property
+    def is_fixture(self) -> bool:
+        return "lint_fixtures" in Path(self.rel).parts
+
+
+@dataclasses.dataclass
+class Context:
+    """Everything a pass may look at: the parsed corpus plus repo root
+    (for docs/package files outside the explicit path set) and the
+    analysis configuration (overridable by fixture tests)."""
+
+    root: Path
+    files: List[SourceFile]
+    config: "object" = None  # scripts.lint.config.Config, set by run()
+
+    def by_suffix(self, suffix: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel.endswith(suffix):
+                return f
+        return None
+
+
+def load_file(path: Path, root: Path) -> SourceFile:
+    src = path.read_text()
+    try:
+        rel = path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    tree, err = None, None
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        err = (e.lineno or 0, e.msg or "syntax error")
+    covers, unknown = parse_suppressions(src)
+    return SourceFile(
+        path=path, rel=rel, source=src, tree=tree, syntax_error=err,
+        suppressions=covers, unknown_ignores=unknown,
+    )
+
+
+def iter_py_files(paths, root: Path) -> List[Path]:
+    """Expand path arguments into a sorted list of .py files, skipping
+    :data:`EXCLUDE_DIR_NAMES` during directory walks (an explicitly named
+    file is always included — the fixture tests rely on that)."""
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not EXCLUDE_DIR_NAMES & set(f.parts):
+                    files.append(f)
+        elif p.suffix == ".py" and p.exists():
+            files.append(p)
+    return files
+
+
+# -- running ------------------------------------------------------------------
+
+
+def apply_suppressions(findings: List[Finding], files: List[SourceFile]) -> None:
+    by_rel = {f.rel: f for f in files}
+    for fd in findings:
+        sf = by_rel.get(fd.path)
+        if sf is None:
+            continue
+        at = sf.suppressions.get(fd.line, {})
+        if fd.rule in at:
+            fd.suppressed = True
+            fd.suppress_reason = at[fd.rule] or "(no reason given)"
+
+
+def load_baseline(path: Path) -> set:
+    data = json.loads(path.read_text())
+    return {
+        (e["rule"], e["path"], e["message"]) for e in data.get("findings", [])
+    }
+
+
+def write_baseline(path: Path, findings: List[Finding]) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "message": f.message}
+        for f in findings
+        if not f.suppressed
+    ]
+    path.write_text(json.dumps({"version": 1, "findings": entries}, indent=2))
+
+
+def run(paths=None, root: Optional[Path] = None, config=None) -> Tuple[List[Finding], List[SourceFile]]:
+    """Run every registered pass over ``paths``; returns (findings, files)
+    with line-level suppressions already applied (baseline is the CLI's
+    job — library callers see everything)."""
+    # rule/pass modules register themselves on import
+    from . import rules  # noqa: F401  (registration side effect)
+    from .config import Config
+
+    root = Path(root) if root is not None else _find_root()
+    cfg = config or Config()
+    files = [load_file(p, root) for p in iter_py_files(paths or DEFAULT_PATHS, root)]
+    ctx = Context(root=root, files=files, config=cfg)
+    findings: List[Finding] = []
+    for p in PASSES:
+        findings.extend(p(ctx))
+    apply_suppressions(findings, files)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, files
+
+
+def _find_root() -> Path:
+    """The repo root: the directory holding ``bevy_ggrs_tpu`` — two levels
+    up from this file (scripts/lint/core.py)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _format_text(findings: List[Finding], show_suppressed: bool) -> List[str]:
+    out = []
+    for f in findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        flag = " [suppressed]" if f.suppressed else ""
+        out.append(f"{f.path}:{f.line}: {f.rule} ({f.severity}){flag}: {f.message}")
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m scripts.lint",
+        description="bevy_ggrs_tpu determinism analyzer / lint framework",
+    )
+    ap.add_argument("paths", nargs="*", help=f"files/dirs (default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", metavar="FILE", help="write a JSON report ('-' for stdout)")
+    ap.add_argument("--baseline", metavar="FILE", help="fingerprints to tolerate")
+    ap.add_argument("--write-baseline", metavar="FILE", help="write current findings as a baseline")
+    ap.add_argument("--show-suppressed", action="store_true", help="also print suppressed findings")
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from . import rules  # noqa: F401
+
+        for r in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{r.id}  {r.severity:7s}  {r.name}: {r.summary}")
+        return 0
+
+    findings, _files = run(args.paths or None)
+
+    if args.baseline:
+        known = load_baseline(Path(args.baseline))
+        for f in findings:
+            if not f.suppressed and f.fingerprint() in known:
+                f.suppressed = True
+                f.suppress_reason = "baseline"
+    if args.write_baseline:
+        write_baseline(Path(args.write_baseline), findings)
+
+    for line in _format_text(findings, args.show_suppressed):
+        print(line)
+
+    active = [f for f in findings if not f.suppressed]
+    errors = [f for f in active if f.severity == "error"]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.json:
+        report = {
+            "version": 1,
+            "counts": {
+                "findings": len(active),
+                "errors": len(errors),
+                "warnings": len(active) - len(errors),
+                "suppressed": len(suppressed),
+            },
+            "findings": [f.as_dict() for f in findings],
+            "rules": [dataclasses.asdict(r) for r in sorted(RULES.values(), key=lambda r: r.id)],
+        }
+        text = json.dumps(report, indent=2)
+        if args.json == "-":
+            print(text)
+        else:
+            Path(args.json).write_text(text + "\n")
+
+    print(
+        f"lint: {len(RULES)} rules, {len(active)} findings "
+        f"({len(errors)} errors, {len(suppressed)} suppressed)"
+    )
+    return 1 if errors else 0

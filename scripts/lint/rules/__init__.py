@@ -1,0 +1,21 @@
+"""Rule passes — importing this package registers every rule and pass.
+
+Each module declares its rule ids with :func:`..core.rule` at import time
+and registers one or more :func:`..core.lint_pass` functions.  The id
+blocks are stable API (baselines, suppression comments and the docs
+catalog all key on them):
+
+- ``BGT00x`` hygiene: unused imports, duplicate defs, syntax, bad ignores
+- ``BGT01x`` hot-loop purity (intra + interprocedural + allowlist meta)
+- ``BGT02x`` tick-phase timer discipline
+- ``BGT03x`` metric-name <-> docs-catalog cross-check
+- ``BGT04x`` determinism hazards in step/model/session code
+- ``BGT05x`` rule-id <-> docs-catalog cross-check
+"""
+
+from . import imports  # noqa: F401
+from . import purity  # noqa: F401
+from . import phases  # noqa: F401
+from . import metrics  # noqa: F401
+from . import determinism  # noqa: F401
+from . import docs  # noqa: F401

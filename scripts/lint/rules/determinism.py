@@ -1,0 +1,259 @@
+"""Determinism-hazard rules for step/model/session code (BGT040-BGT044).
+
+Every peer re-simulates bit-identically — that is the whole contract
+(docs/determinism.md).  Any nondeterminism is a silent desync that SyncTest
+can only catch at runtime, frames after the fact; these rules enforce the
+property statically, at the program level:
+
+- **BGT040 wall-clock**: ``time.time()`` / ``time.monotonic()`` inside sim
+  code (models/, ops/) — frame-derived time is the only clock a step
+  function may see (``StepCtx.time``).  ``perf_counter`` is deliberately
+  allowed: it feeds telemetry, never state.
+- **BGT041 unseeded RNG**: the process-global ``random`` module RNG and
+  ``np.random`` module-level sampling share hidden state across call sites
+  and peers; all randomness must flow from an explicit seed
+  (``np.random.default_rng(seed)``, ``random.Random(seed)``, or the
+  per-frame ``ctx.rng_key`` fold).
+- **BGT042 set-iteration order**: iterating a ``set`` into ``sum()`` or an
+  array constructor bakes hash order (PYTHONHASHSEED-dependent for str)
+  into float accumulation order / array layout — sort first.
+- **BGT043 host callbacks in jitted step code**: ``jax.debug.*`` /
+  ``io_callback`` / ``pure_callback`` inside sim code round-trips to host
+  mid-program — a sync leak at best, an ordering hazard under async
+  dispatch at worst.
+- **BGT044 frozen-world mutation**: in-place assignment into ``world``
+  (``world.comps[...] = x``) bypasses the immutable-snapshot contract the
+  save ring depends on; use ``dataclasses.replace``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+
+rule(
+    "BGT040", "wall-clock-in-step",
+    summary="wall-clock read inside sim code — use frame-derived ctx.time",
+)
+rule(
+    "BGT041", "unseeded-rng",
+    summary="process-global RNG use — derive all randomness from an explicit seed",
+)
+rule(
+    "BGT042", "set-iteration-order",
+    summary="set iteration feeding sum()/array construction bakes in hash order",
+)
+rule(
+    "BGT043", "host-callback-in-step",
+    summary="jax.debug/io_callback/pure_callback inside sim code",
+)
+rule(
+    "BGT044", "frozen-world-mutation",
+    summary="in-place mutation of the frozen world — use dataclasses.replace",
+)
+
+_WALL_CLOCK = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.date.today",
+})
+# seeded-constructor names exempt under numpy.random / random
+_RNG_CTORS = frozenset({"default_rng", "Generator", "SeedSequence", "PCG64",
+                        "Philox", "RandomState", "Random", "SystemRandom"})
+_HOST_CALLBACKS = frozenset({
+    "jax.experimental.io_callback", "jax.pure_callback",
+    "jax.experimental.pure_callback",
+})
+_ARRAY_CTORS = frozenset({"asarray", "array", "stack", "concatenate",
+                          "hstack", "vstack", "fromiter"})
+
+
+def _alias_map(tree: ast.AST) -> Dict[str, str]:
+    """name bound in this module -> dotted path it refers to
+    (``np`` -> ``numpy``, ``getrandbits`` -> ``random.getrandbits``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    aliases[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted_path(func, aliases: Dict[str, str]) -> Optional[str]:
+    """Fully-resolved dotted path of a call target, or None for anything
+    that is not a plain Name/Attribute-of-Names chain."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = aliases.get(node.id, node.id)
+    return ".".join([root] + list(reversed(parts)))
+
+
+def _is_set_expr(node) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("set", "frozenset"):
+        return True
+    return False
+
+
+def _iterates_a_set(arg) -> bool:
+    """True when ``arg`` is a set expression or a comprehension whose
+    outermost iterable is one."""
+    if _is_set_expr(arg):
+        return True
+    if isinstance(arg, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+        return _is_set_expr(arg.generators[0].iter)
+    return False
+
+
+def _enclosing_functions(tree: ast.AST) -> Dict[int, str]:
+    """id(node) -> name of the innermost enclosing function (for scoping
+    wall-clock: module-level timing constants are not step code)."""
+    owner: Dict[int, str] = {}
+
+    def walk(node, fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node.name
+        owner[id(node)] = fn
+        for child in ast.iter_child_nodes(node):
+            walk(child, fn)
+
+    walk(tree, None)
+    return owner
+
+
+def check_determinism(sf: SourceFile, in_sim: bool) -> List[Finding]:
+    """All BGT04x findings for one file; BGT041/BGT042 run everywhere the
+    pass is scoped, BGT040/BGT043/BGT044 only in sim code."""
+    tree = sf.tree
+    aliases = _alias_map(tree)
+    owner = _enclosing_functions(tree) if in_sim else {}
+    out: List[Finding] = []
+
+    for node in ast.walk(tree):
+        # BGT044: in-place mutation of the frozen world ------------------
+        if in_sim and isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            flat = []
+            for t in targets:
+                flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t])
+            for t in flat:
+                root = t
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "world" and root is not t:
+                    out.append(Finding(
+                        "BGT044", sf.rel, t.lineno,
+                        "frozen-world mutation: assigning into `world` "
+                        "in-place corrupts every snapshot sharing the "
+                        "buffer — build the new state with "
+                        "dataclasses.replace(world, ...)",
+                    ))
+
+        if not isinstance(node, ast.Call):
+            continue
+        path = _dotted_path(node.func, aliases)
+
+        # BGT042: set iteration feeding order-sensitive accumulation -----
+        consumer = None
+        if isinstance(node.func, ast.Name) and node.func.id == "sum":
+            consumer = "sum()"
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr in _ARRAY_CTORS:
+                consumer = f".{node.func.attr}()"
+            elif node.func.attr == "join":
+                consumer = ".join()"
+        if consumer and node.args and _iterates_a_set(node.args[0]):
+            out.append(Finding(
+                "BGT042", sf.rel, node.lineno,
+                f"set-iteration order: {consumer} over a set bakes hash "
+                "order into the result (float accumulation order / array "
+                "layout differ across peers under PYTHONHASHSEED) — "
+                "iterate sorted(...) instead",
+            ))
+
+        if path is None:
+            continue
+
+        # BGT040: wall-clock in sim code ---------------------------------
+        if in_sim and path in _WALL_CLOCK and owner.get(id(node)) is not None:
+            out.append(Finding(
+                "BGT040", sf.rel, node.lineno,
+                f"wall-clock read: {path}() inside sim code desyncs peers "
+                "— step functions may only see frame-derived time "
+                "(StepCtx.time = frame / fps)",
+            ))
+
+        # BGT041: process-global RNG -------------------------------------
+        parts = path.split(".")
+        if parts[0] == "random" and len(parts) == 2:
+            fn = parts[1]
+            if fn not in _RNG_CTORS:
+                out.append(Finding(
+                    "BGT041", sf.rel, node.lineno,
+                    f"unseeded RNG: random.{fn}() uses the process-global "
+                    "generator — peers (and reruns) draw different "
+                    "streams; use random.Random(seed) or the per-frame "
+                    "ctx.rng_key fold",
+                ))
+            elif fn in ("Random", "RandomState") and not node.args:
+                out.append(Finding(
+                    "BGT041", sf.rel, node.lineno,
+                    f"unseeded RNG: random.{fn}() without a seed argument "
+                    "is nondeterministic across runs — pass an explicit "
+                    "seed",
+                ))
+        elif len(parts) >= 2 and parts[0] == "numpy" and parts[1] == "random":
+            fn = parts[-1]
+            if fn not in _RNG_CTORS and len(parts) >= 3:
+                out.append(Finding(
+                    "BGT041", sf.rel, node.lineno,
+                    f"unseeded RNG: np.random.{fn}() samples the legacy "
+                    "module-global RNG — use np.random.default_rng(seed)",
+                ))
+            elif fn in ("default_rng", "RandomState") and not node.args:
+                out.append(Finding(
+                    "BGT041", sf.rel, node.lineno,
+                    f"unseeded RNG: np.random.{fn}() without a seed is "
+                    "OS-entropy seeded — pass the explicit seed param",
+                ))
+
+        # BGT043: host callbacks in jitted sim code ----------------------
+        if in_sim and (
+            path in _HOST_CALLBACKS
+            or path.startswith("jax.debug.")
+            or path.endswith(".io_callback")
+            or path == "io_callback"
+        ):
+            out.append(Finding(
+                "BGT043", sf.rel, node.lineno,
+                f"host callback in sim code: {path}() round-trips "
+                "device->host inside the jitted step — a sync leak that "
+                "voids pipelining and an ordering hazard under async "
+                "dispatch; strip it before shipping",
+            ))
+    return out
+
+
+@lint_pass
+def determinism_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        out.extend(check_determinism(sf, in_sim=cfg.in_sim_code(sf.rel)))
+    return out

@@ -1,0 +1,75 @@
+"""Rule-id <-> docs-catalog cross-check (BGT050/BGT051).
+
+docs/static-analysis.md carries the human-facing rule catalog (what each
+rule catches, why it matters for determinism, how to suppress it).  The
+registry in :mod:`..core` is the machine truth; this pass diffs the two in
+both directions, the same way the metric<->docs lint works, so the catalog
+can neither rot nor silently under-document a new rule.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ..core import RULES, Context, Finding, lint_pass, rule
+
+rule(
+    "BGT050", "undocumented-rule",
+    summary="a registered rule id has no docs/static-analysis.md row",
+)
+rule(
+    "BGT051", "stale-rule-doc",
+    summary="a documented rule id is not registered in the analyzer",
+)
+
+_RULE_ID_IN_DOCS = re.compile(r"`(BGT0\d\d)`")
+
+
+def docs_rule_ids(md_text: str) -> set:
+    """Rule ids named in the first column of every ``| rule | ... |`` table."""
+    ids = set()
+    in_table = False
+    for line in md_text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "rule":
+            in_table = True
+            continue
+        if in_table and not set(cells[0]) <= set("-: "):
+            ids.update(_RULE_ID_IN_DOCS.findall(cells[0]))
+    return ids
+
+
+@lint_pass
+def docs_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    if not cfg.project_checks:
+        return []
+    docs_path = ctx.root / cfg.rule_docs
+    if not docs_path.exists():
+        return [Finding(
+            "BGT050", cfg.rule_docs, 0,
+            "rule catalog file missing — every BGT0xx rule must be "
+            "documented (id, what it catches, why, how to suppress)",
+        )]
+    doc_ids = docs_rule_ids(docs_path.read_text())
+    out: List[Finding] = []
+    for rid in sorted(set(RULES) - doc_ids):
+        out.append(Finding(
+            "BGT050", cfg.rule_docs, 0,
+            f"rule {rid} ({RULES[rid].name}) is registered in the analyzer "
+            "but missing from the docs catalog (add a `| rule | ... |` row)",
+        ))
+    for rid in sorted(doc_ids - set(RULES)):
+        out.append(Finding(
+            "BGT051", cfg.rule_docs, 0,
+            f"rule {rid} is documented in the catalog but not registered "
+            "in the analyzer (stale row — remove or fix the id)",
+        ))
+    return out

@@ -1,0 +1,119 @@
+"""Hygiene rules: unused imports, duplicate definitions, syntax errors,
+and malformed suppression comments (ported from the original
+``scripts/lint_imports.py`` stdlib checker).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Context, Finding, lint_pass, rule
+
+rule(
+    "BGT001", "unused-import",
+    summary="an imported name is never referenced in the module",
+)
+rule(
+    "BGT002", "duplicate-definition",
+    summary="a def/class silently shadows an earlier same-scope binding",
+)
+rule(
+    "BGT003", "syntax-error",
+    summary="the file does not parse",
+)
+rule(
+    "BGT004", "unknown-suppression",
+    summary="a '# bgt: ignore[...]' comment names a rule id that does not exist",
+)
+
+# re-export / intentional-import conventions that must not be flagged
+_ALLOW_UNUSED_IN = ("__init__.py",)
+
+
+def _names_loaded(tree: ast.AST) -> set:
+    """Every bare name and attribute-root referenced anywhere in the tree."""
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # walk to the root of a dotted access (os.path.join -> os)
+            inner = node.value
+            while isinstance(inner, ast.Attribute):
+                inner = inner.value
+            if isinstance(inner, ast.Name):
+                used.add(inner.id)
+    # names referenced inside string annotations / __all__ entries count
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.add(node.value)
+    return used
+
+
+def check_unused_imports(tree: ast.AST, source: str, allow_unused: bool = False):
+    """``(line, message)`` pairs for imports nobody uses (pure helper —
+    the old lint_imports API shape, reused by the shim)."""
+    problems = []
+    used = _names_loaded(tree)
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue  # compiler directives, not bindings
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "noqa" in line or allow_unused:
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in used and bound != "_":
+                problems.append(
+                    (node.lineno, f"unused import: {alias.asname or alias.name}")
+                )
+    return problems
+
+
+def check_duplicate_defs(tree: ast.AST):
+    """``(line, message)`` pairs for same-scope def/class shadowing."""
+    problems = []
+    for scope in ast.walk(tree):
+        if not isinstance(scope, (ast.Module, ast.ClassDef)):
+            continue
+        seen = {}
+        for stmt in scope.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                # any decorator exempts: @property/@x.setter pairs,
+                # @overload stacks, @pytest.fixture shadowing, ...
+                if stmt.name in seen and not stmt.decorator_list:
+                    problems.append(
+                        (stmt.lineno,
+                         f"duplicate definition of {stmt.name!r} "
+                         f"(first at line {seen[stmt.name]})")
+                    )
+                seen[stmt.name] = stmt.lineno
+    return problems
+
+
+@lint_pass
+def hygiene_pass(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    for f in ctx.files:
+        if f.syntax_error is not None:
+            line, msg = f.syntax_error
+            out.append(Finding("BGT003", f.rel, line, f"syntax error: {msg}"))
+            continue
+        for line, rid in f.unknown_ignores:
+            out.append(Finding(
+                "BGT004", f.rel, line,
+                f"suppression names unknown rule id {rid!r} "
+                "(typo? run --list-rules for the catalog)",
+            ))
+        allow_unused = f.path.name in _ALLOW_UNUSED_IN
+        for line, msg in check_unused_imports(f.tree, f.source, allow_unused):
+            out.append(Finding("BGT001", f.rel, line, msg))
+        for line, msg in check_duplicate_defs(f.tree):
+            out.append(Finding("BGT002", f.rel, line, msg))
+    return out

@@ -1,0 +1,127 @@
+"""Metric-name <-> docs-catalog cross-check (BGT030/BGT031), ported from
+the original ``lint_imports.py``.
+
+Every metric the package/scripts register with a literal name must appear
+in a ``| metric | ... |`` table of docs/observability.md, and every name
+the docs catalog lists must still be registered somewhere — both
+directions, so the catalog can neither rot nor silently under-document new
+families.  Tests are excluded (they register throwaway names on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from ..core import Context, Finding, lint_pass, rule
+
+rule(
+    "BGT030", "undocumented-metric",
+    summary="a metric registered in code has no docs/observability.md row",
+)
+rule(
+    "BGT031", "stale-metric-doc",
+    summary="a documented metric name is never registered in code",
+)
+
+# registry/shorthand entry points whose first positional arg is the name
+_METRIC_REG_ATTRS = {
+    "counter", "gauge", "histogram",
+    "bind_counter", "bind_gauge", "bind_histogram", "gauge_set",
+}
+# telemetry-module shorthands; gated on the receiver being `telemetry` so
+# unrelated `.count("x")` / `.observe(...)` methods never false-positive
+_METRIC_TELEMETRY_ATTRS = {"count", "observe", "gauge_set"}
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]{2,}$")
+
+
+def _attr_root(node: ast.Attribute):
+    """Name at the root of a dotted/called access, e.g. ``registry().x`` or
+    ``a.b.c`` -> ``registry`` / ``a`` (None when the root is not a name)."""
+    inner = node.value
+    while isinstance(inner, (ast.Attribute, ast.Call)):
+        inner = inner.func if isinstance(inner, ast.Call) else inner.value
+    return inner.id if isinstance(inner, ast.Name) else None
+
+
+def collect_metric_names(tree: ast.AST) -> set:
+    """Metric names registered with a string literal anywhere in ``tree``."""
+    names = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr in _METRIC_TELEMETRY_ATTRS:
+            if _attr_root(node.func) != "telemetry":
+                continue
+        elif attr not in _METRIC_REG_ATTRS:
+            continue
+        if not node.args:
+            continue
+        a0 = node.args[0]
+        # a conditional name picks one of two literals (runner.py's
+        # speculation hit/miss counter) — both are registered names
+        cands = [a0.body, a0.orelse] if isinstance(a0, ast.IfExp) else [a0]
+        for c in cands:
+            if isinstance(c, ast.Constant) and isinstance(c.value, str) \
+                    and _METRIC_NAME_RE.match(c.value):
+                names.add(c.value)
+    return names
+
+
+def docs_metric_names(md_text: str) -> set:
+    """Backticked names in the first column of every ``| metric | ... |``
+    table in the docs catalog."""
+    names = set()
+    in_table = False
+    for line in md_text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "metric":
+            in_table = True
+            continue
+        if in_table and not set(cells[0]) <= set("-: "):
+            names.update(re.findall(r"`([a-z][a-z0-9_]+)`", cells[0]))
+    return names
+
+
+@lint_pass
+def metrics_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    if not cfg.project_checks:
+        return []
+    code_names = set()
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test or sf.is_fixture:
+            continue
+        code_names |= collect_metric_names(sf.tree)
+    docs_path = ctx.root / cfg.metric_docs
+    if not docs_path.exists():
+        return [Finding("BGT031", cfg.metric_docs, 0, "metric catalog file missing")]
+    doc_names = docs_metric_names(docs_path.read_text())
+    out: List[Finding] = []
+    for name in sorted(code_names - doc_names):
+        out.append(Finding(
+            "BGT030", cfg.metric_docs, 0,
+            f"metric {name!r} is registered in code but missing from the "
+            "docs catalog (add a `| metric | labels | meaning |` row)",
+        ))
+    # the reverse (stale-row) direction needs the FULL registration corpus —
+    # a partial-path run must not call a row stale just because the file
+    # that registers it was not linted (same guard as the BGT022 reverse
+    # check); the package __init__ in the corpus is the full-run proxy
+    full_corpus = ctx.by_suffix(cfg.package_dir + "/__init__.py") is not None
+    if full_corpus:
+        for name in sorted(doc_names - code_names):
+            out.append(Finding(
+                "BGT031", cfg.metric_docs, 0,
+                f"metric {name!r} is documented in the catalog but never "
+                "registered in code (stale row — remove or fix the name)",
+            ))
+    return out

@@ -1,0 +1,159 @@
+"""Tick-phase timer discipline (BGT020/BGT021) and the stale-catalog
+meta-lint (BGT022).
+
+The phase catalog is **extracted from the package source by AST literal
+parsing** (``extract_phase_catalog``) — the lint must not import
+``bevy_ggrs_tpu`` (that pulls jax), and the previous hand-mirrored copy in
+``lint_imports.py`` was itself a determinism hazard for the lint: a new
+phase added to ``telemetry/phases.py`` without updating the mirror would
+have been flagged as a typo.  ``tests/test_phases.py`` keeps the identity
+assertion as a regression guard.
+
+Every ``.phase("<literal>")`` call in the drivers must name a catalog phase
+(a typo would silently leak its time into ``unattributed_ms``) and must be
+a ``with``-statement context expression (a bare call never runs
+``__enter__``/``__exit__``, so it times nothing).  BGT022 closes the other
+direction: a catalog phase no driver ever times is dead weight that skews
+``unattributed_pct`` readers.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Set
+
+from ..core import Context, Finding, lint_pass, rule
+
+rule(
+    "BGT020", "phase-name",
+    summary=".phase() with a non-literal or non-catalog phase name",
+)
+rule(
+    "BGT021", "phase-not-timed",
+    summary=".phase() call outside a with-statement times nothing",
+)
+rule(
+    "BGT022", "stale-phase-catalog",
+    summary="a catalog phase is never timed by any driver",
+)
+
+
+def extract_phase_catalog(phases_path: Path) -> Optional[Set[str]]:
+    """The ``PHASES = ("...", ...)`` tuple of telemetry/phases.py, read by
+    AST literal parsing — no package import, no jax.  Returns None when the
+    file or the assignment cannot be found (reported as BGT022 upstream)."""
+    try:
+        tree = ast.parse(phases_path.read_text(), filename=str(phases_path))
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+            value = node.value
+        else:
+            continue
+        if "PHASES" not in targets or value is None:
+            continue
+        if isinstance(value, (ast.Tuple, ast.List)):
+            names = set()
+            for elt in value.elts:
+                if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                    return None  # non-literal element: cannot trust the parse
+                names.add(elt.value)
+            return names
+    return None
+
+
+def check_phases(tree: ast.AST, catalog: Set[str]) -> list:
+    """Return ``(line, message, used_name_or_None)`` for ``.phase(...)``
+    misuse; well-formed sites contribute their name via the third slot so
+    the caller can do the BGT022 reverse check."""
+    problems = []
+    used: Set[str] = set()
+    with_exprs = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_exprs.add(id(item.context_expr))
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "phase"
+        ):
+            continue
+        if (
+            len(node.args) != 1
+            or node.keywords
+            or not isinstance(node.args[0], ast.Constant)
+            or not isinstance(node.args[0].value, str)
+        ):
+            problems.append((
+                node.lineno,
+                "phase timer: .phase() takes one string literal "
+                "(dynamic names defeat the catalog lint)",
+                "BGT020",
+            ))
+            continue
+        name = node.args[0].value
+        used.add(name)
+        if name not in catalog:
+            problems.append((
+                node.lineno,
+                f"phase timer: {name!r} is not in the phase catalog "
+                f"{sorted(catalog)} — its time would silently land "
+                "in unattributed_ms (telemetry/phases.py)",
+                "BGT020",
+            ))
+        if id(node) not in with_exprs:
+            problems.append((
+                node.lineno,
+                f"phase timer: .phase({name!r}) must be a with-statement "
+                "context expression — a bare call times nothing",
+                "BGT021",
+            ))
+    return problems, used
+
+
+@lint_pass
+def phases_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    catalog = extract_phase_catalog(ctx.root / cfg.phases_module)
+    if catalog is None:
+        if cfg.project_checks:
+            out.append(Finding(
+                "BGT022", cfg.phases_module, 0,
+                "could not extract the PHASES tuple by AST literal parsing "
+                "— the catalog must stay a flat tuple of string literals "
+                "so the lint can read it without importing jax",
+            ))
+        return out
+    used_anywhere: Set[str] = set()
+    drivers_seen: Set[str] = set()
+    for sf in ctx.files:
+        if sf.tree is None or not any(sf.rel.endswith(s) for s in cfg.phase_files):
+            continue
+        drivers_seen.add(sf.rel)
+        problems, used = check_phases(sf.tree, catalog)
+        used_anywhere |= used
+        for line, msg, rid in problems:
+            out.append(Finding(rid, sf.rel, line, msg))
+    # the reverse (stale-catalog) check needs the FULL driver set in the
+    # corpus — a partial-path run must not call a phase stale just because
+    # the driver that times it was not linted
+    if cfg.project_checks and len(drivers_seen) == len(cfg.phase_files):
+        for name in sorted(catalog - used_anywhere):
+            out.append(Finding(
+                "BGT022", cfg.phases_module, 0,
+                f"stale catalog: phase {name!r} is declared in PHASES but "
+                "never timed by any driver "
+                f"({', '.join(cfg.phase_files)}) — dead catalog entries "
+                "skew unattributed_pct readers",
+            ))
+    return out

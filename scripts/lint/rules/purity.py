@@ -1,0 +1,530 @@
+"""Hot-loop purity — intra-function (BGT010), interprocedural (BGT011),
+and the stale-allowlist meta-lint (BGT012).
+
+The pipelined tick engine (docs/architecture.md "Tick pipeline") depends on
+the hot loop never forcing a device->host sync: one stray
+``block_until_ready`` / ``device_get`` / eager ``.to_int`` in the dispatch
+path re-serializes host against device and silently voids the overlap, with
+no test failing.  Forcing reads are allowed only inside the allowlisted
+flush funnels (config.PURITY_ALLOW).
+
+BGT010 is the original syntactic rule: forcing *syntax* outside an
+allowlisted function of a covered file.  It is trivially defeated by a
+one-line refactor — move the forcing read into a helper and call the
+helper.  BGT011 closes that hole: it builds a call graph over the whole
+package, seeds every function whose body contains forcing syntax, and
+propagates the "forces device->host sync" effect backwards through call
+edges, so a hot-loop function reaching a forcing helper N calls deep is
+flagged *at the call site* with the full chain in the message.
+
+Call-edge resolution is deliberately conservative (no type inference):
+
+- ``f(...)``            -> same-module function, else a ``from x import f``
+- ``self.m(...)``       -> method of the enclosing class (same module)
+- ``mod.f(...)``        -> function of an imported module
+- ``Cls.m(...)``        -> method of a same-module or imported class
+- ``obj.m(...)``        -> *unique-name fallback*: resolves only when
+  exactly one function/method in the package is named ``m`` (and the name
+  is not on the common-method skip list) — this is what lets
+  ``self._checks.try_host()`` resolve without type information.
+
+A helper that contains forcing syntax only on a guarded non-blocking path
+(reads after ``is_ready()``) is sanctioned by putting
+``# bgt: ignore[BGT011]: <why>`` on the forcing line — that stops the
+effect from seeding there, for every caller.  Allowlisted funnels never
+propagate: calling ``checksum`` / ``_drain_inflight`` from hot code is the
+design, not a leak.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import PurePosixPath
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+
+rule(
+    "BGT010", "hot-loop-purity",
+    summary="forcing device->host read outside an allowlisted flush funnel",
+)
+rule(
+    "BGT011", "interprocedural-purity",
+    summary="hot-loop call reaches a device->host-forcing helper through the call graph",
+)
+rule(
+    "BGT012", "stale-purity-allowlist",
+    summary="PURITY_ALLOW names a function that no longer exists in its target file",
+)
+
+# receiver-less method names too generic for the unique-name fallback —
+# a dict's .get or a socket's .send must never resolve to package code
+_COMMON_METHOD_NAMES = frozenset({
+    "get", "set", "put", "pop", "add", "append", "extend", "remove", "clear",
+    "items", "keys", "values", "update", "copy", "join", "split", "strip",
+    "read", "write", "close", "open", "send", "recv", "flush", "seek",
+    "start", "stop", "run", "next", "sort", "index", "count", "insert",
+    "encode", "decode", "format", "replace", "setdefault", "reshape",
+    "astype", "tobytes", "item", "mean", "sum", "min", "max", "step",
+})
+
+
+# -- intra-function (BGT010) --------------------------------------------------
+
+
+def check_purity(tree: ast.AST, allow: set,
+                 attrs: frozenset = None, names: frozenset = None) -> list:
+    """Return ``(line, message)`` for forcing reads outside ``allow``-listed
+    functions (attribute accesses count even un-called: holding a bound
+    ``.to_int`` and calling it later forces just the same)."""
+    from ..config import PURITY_ATTRS, PURITY_NAMES
+
+    attrs = PURITY_ATTRS if attrs is None else attrs
+    names = PURITY_NAMES if names is None else names
+    problems = []
+
+    def walk(node, fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node.name
+        bad = None
+        if isinstance(node, ast.Attribute) and node.attr in attrs:
+            bad = f".{node.attr}"
+        elif isinstance(node, ast.Name) and node.id in names:
+            bad = node.id
+        if bad is not None and fn not in allow:
+            problems.append((
+                node.lineno,
+                f"hot-loop purity: {bad} in {fn or '<module>'}() — forcing "
+                "device->host reads is allowed only in "
+                f"{sorted(allow)} (see docs/architecture.md tick pipeline)",
+            ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, fn)
+
+    walk(tree, None)
+    return problems
+
+
+# -- call graph (BGT011) ------------------------------------------------------
+
+FuncKey = Tuple[str, str]  # (module rel path, qualname)
+
+
+@dataclasses.dataclass
+class _Func:
+    key: FuncKey
+    lineno: int
+    cls: Optional[str]
+    # (line, what) forcing syntax inside the body, minus BGT011-suppressed
+    direct: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    # (line, raw call ref) — resolved after all modules are collected
+    calls: List[Tuple[int, tuple]] = dataclasses.field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.key[1].rsplit(".", 1)[-1]
+
+
+@dataclasses.dataclass
+class _Module:
+    rel: str
+    dotted: str
+    is_pkg: bool = False  # an __init__.py — anchors relative imports at itself
+    funcs: Dict[str, _Func] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    # alias -> ("module", dotted) | ("symbol", dotted_module, symbol)
+    imports: Dict[str, tuple] = dataclasses.field(default_factory=dict)
+
+
+def _dotted(rel: str, package_parent: str) -> str:
+    p = PurePosixPath(rel)
+    if package_parent:
+        try:
+            p = p.relative_to(package_parent)
+        except ValueError:
+            pass
+    parts = list(p.parts)
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]  # strip .py
+    return ".".join(parts)
+
+
+def _resolve_import_module(cur_dotted: str, is_pkg: bool,
+                           node: ast.ImportFrom) -> Optional[str]:
+    """Absolute dotted module named by a possibly-relative ImportFrom.
+    A plain module's level-1 anchor is its parent package; an
+    ``__init__.py``'s is the package itself."""
+    if node.level == 0:
+        return node.module
+    base = cur_dotted.split(".")
+    drop = node.level - 1 if is_pkg else node.level
+    if drop:
+        base = base[:len(base) - drop]
+    if not base and not node.module:
+        return None
+    return ".".join(base + (node.module.split(".") if node.module else []))
+
+
+class _Collector(ast.NodeVisitor):
+    """One module's functions, classes, imports and raw call refs."""
+
+    def __init__(self, mod: _Module, sf: SourceFile, attrs, names):
+        self.mod = mod
+        self.sf = sf
+        self.attrs = attrs
+        self.names = names
+        self._stack: List[str] = []  # qualname segments
+        self._cls: List[Optional[str]] = []
+
+    # imports ---------------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            if a.asname:
+                self.mod.imports[a.asname] = ("module", a.name)
+            else:
+                root = a.name.split(".")[0]
+                self.mod.imports[root] = ("module", root)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        base = _resolve_import_module(self.mod.dotted, self.mod.is_pkg, node)
+        if base is None:
+            return
+        for a in node.names:
+            alias = a.asname or a.name
+            # `from pkg import mod` is a module alias when pkg.mod exists;
+            # the resolver decides at lookup time, so record both shapes
+            self.mod.imports[alias] = ("symbol", base, a.name)
+
+    # defs ------------------------------------------------------------------
+    def _enter_func(self, node):
+        qual = ".".join(self._stack + [node.name])
+        cls = self._cls[-1] if self._cls else None
+        fn = _Func(key=(self.mod.rel, qual), lineno=node.lineno, cls=cls)
+        self.mod.funcs[qual] = fn
+        if cls is not None and len(self._stack) == 1:
+            self.mod.classes.setdefault(cls, set()).add(node.name)
+        self._stack.append(node.name)
+        self._cls.append(None)
+        self._scan_body(node, fn)
+        self.generic_visit(node)
+        self._cls.pop()
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter_func(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.mod.classes.setdefault(node.name, set())
+        self._stack.append(node.name)
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+        self._stack.pop()
+
+    # body scan (only direct statements of this function, not nested defs) --
+    def _scan_body(self, fnode, fn: _Func):
+        def inner(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue  # nested defs get their own _Func
+                self._scan_node(child, fn)
+                inner(child)
+
+        inner(fnode)
+
+    def _scan_node(self, node, fn: _Func):
+        # forcing syntax seeds the effect — unless the line carries a
+        # BGT011 suppression (a sanctioned non-blocking guard)
+        if isinstance(node, ast.Attribute) and node.attr in self.attrs:
+            if "BGT011" not in self.sf.suppressions.get(node.lineno, {}):
+                fn.direct.append((node.lineno, f".{node.attr}"))
+        elif isinstance(node, ast.Name) and node.id in self.names:
+            if "BGT011" not in self.sf.suppressions.get(node.lineno, {}):
+                fn.direct.append((node.lineno, node.id))
+        if not isinstance(node, ast.Call):
+            return
+        f = node.func
+        if isinstance(f, ast.Name):
+            fn.calls.append((node.lineno, ("bare", f.id)))
+        elif isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self":
+                    fn.calls.append((node.lineno, ("self", f.attr)))
+                else:
+                    fn.calls.append((node.lineno, ("name_attr", recv.id, f.attr)))
+            else:
+                # dotted module path like pkg.mod.fn, or an arbitrary
+                # expression receiver — keep the method name for the
+                # unique-name fallback
+                fn.calls.append((node.lineno, ("obj_attr", f.attr)))
+
+
+class CallGraph:
+    """Package-wide call graph with the "forces device->host sync" effect
+    propagated backwards from every seeding site."""
+
+    def __init__(self, ctx: Context):
+        cfg = ctx.config
+        self.cfg = cfg
+        pkg_dir = cfg.package_dir
+        package_parent = str(PurePosixPath(pkg_dir).parent)
+        if package_parent == ".":
+            package_parent = ""
+        self.modules: Dict[str, _Module] = {}  # dotted -> module
+        self.by_rel: Dict[str, _Module] = {}
+        for sf in ctx.files:
+            in_pkg = sf.rel == pkg_dir or sf.rel.startswith(pkg_dir + "/")
+            if not in_pkg or sf.tree is None:
+                continue
+            mod = _Module(
+                rel=sf.rel,
+                dotted=_dotted(sf.rel, package_parent),
+                is_pkg=sf.rel.endswith("__init__.py"),
+            )
+            _Collector(mod, sf, cfg.purity_attrs, cfg.purity_names).visit(sf.tree)
+            self.modules[mod.dotted] = mod
+            self.by_rel[sf.rel] = mod
+        # unique-name index over methods AND functions for the fallback
+        self.by_name: Dict[str, List[_Func]] = {}
+        self.funcs: Dict[FuncKey, _Func] = {}
+        for mod in self.modules.values():
+            for fn in mod.funcs.values():
+                self.funcs[fn.key] = fn
+                self.by_name.setdefault(fn.name, []).append(fn)
+        self._propagate()
+
+    # -- resolution ---------------------------------------------------------
+    def _mod_func(self, mod: _Module, name: str) -> Optional[_Func]:
+        return mod.funcs.get(name)
+
+    def _class_method(self, mod: _Module, cls: str, meth: str) -> Optional[_Func]:
+        return mod.funcs.get(f"{cls}.{meth}")
+
+    def _module_attr(self, mod: _Module, attr: str):
+        """Resolve ``mod.attr``: a def, a class, a submodule, or a
+        re-exported name (an ``from .x import attr`` in the module —
+        typically an ``__init__.py`` facade) chased one hop."""
+        f = self._mod_func(mod, attr)
+        if f is not None:
+            return ("func", f)
+        if attr in mod.classes:
+            return ("class", mod, attr)
+        inner = mod.imports.get(attr)
+        if inner is None:
+            return None
+        if inner[0] == "module":
+            target = self.modules.get(inner[1])
+            return ("module", target) if target else None
+        sub = self.modules.get(f"{inner[1]}.{inner[2]}")
+        if sub is not None:
+            return ("module", sub)
+        src = self.modules.get(inner[1])
+        if src is None:
+            return None
+        f = self._mod_func(src, inner[2])
+        if f is not None:
+            return ("func", f)
+        if inner[2] in src.classes:
+            return ("class", src, inner[2])
+        return None
+
+    def _follow_symbol(self, mod: _Module, alias: str):
+        """What an imported alias refers to: ("module", _Module) or
+        ("class", _Module, clsname) or ("func", _Func) or None."""
+        entry = mod.imports.get(alias)
+        if entry is None:
+            return None
+        if entry[0] == "module":
+            target = self.modules.get(entry[1])
+            return ("module", target) if target else None
+        _, base, symbol = entry
+        # `from pkg import mod` — pkg.mod is a module we know
+        as_module = self.modules.get(f"{base}.{symbol}")
+        if as_module is not None:
+            return ("module", as_module)
+        src = self.modules.get(base)
+        if src is None:
+            return None
+        return self._module_attr(src, symbol)
+
+    def _unique_by_name(self, name: str) -> Optional[_Func]:
+        if name in _COMMON_METHOD_NAMES or name.startswith("__"):
+            return None
+        cands = self.by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def resolve(self, mod: _Module, caller: _Func, ref: tuple) -> Optional[_Func]:
+        kind = ref[0]
+        if kind == "bare":
+            name = ref[1]
+            f = self._mod_func(mod, name)
+            if f is not None:
+                return f
+            sym = self._follow_symbol(mod, name)
+            if sym and sym[0] == "func":
+                return sym[1]
+            return None
+        if kind == "self":
+            meth = ref[1]
+            if caller.cls is not None:
+                f = self._class_method(mod, caller.cls, meth)
+                if f is not None:
+                    return f
+            return self._unique_by_name(meth)
+        if kind == "name_attr":
+            recv, attr = ref[1], ref[2]
+            sym = self._follow_symbol(mod, recv)
+            if sym is not None:
+                if sym[0] == "module":
+                    res = self._module_attr(sym[1], attr)
+                    return res[1] if res and res[0] == "func" else None
+                if sym[0] == "class":
+                    return self._class_method(sym[1], sym[2], attr)
+                if sym[0] == "func":
+                    return None  # attribute of a function — not a call edge
+            if recv in mod.classes:
+                return self._class_method(mod, recv, attr)
+            return self._unique_by_name(attr)
+        if kind == "obj_attr":
+            return self._unique_by_name(ref[1])
+        return None
+
+    # -- effect propagation -------------------------------------------------
+    def _is_allowlisted(self, fn: _Func) -> bool:
+        allow = self.cfg.purity_allowlist_for(fn.key[0])
+        return allow is not None and fn.name in allow
+
+    def _propagate(self):
+        # why[key] = ("direct", line, what) | ("via", line, callee_key)
+        self.why: Dict[FuncKey, tuple] = {}
+        edges_rev: Dict[FuncKey, List[Tuple[_Func, int]]] = {}
+        self.resolved: Dict[FuncKey, List[Tuple[int, _Func]]] = {}
+        for mod in self.modules.values():
+            for fn in mod.funcs.values():
+                res = []
+                for line, ref in fn.calls:
+                    tgt = self.resolve(mod, fn, ref)
+                    if tgt is None or tgt.key == fn.key:
+                        continue
+                    res.append((line, tgt))
+                    edges_rev.setdefault(tgt.key, []).append((fn, line))
+                self.resolved[fn.key] = res
+        work = []
+        for fn in self.funcs.values():
+            if fn.direct and not self._is_allowlisted(fn):
+                line, what = fn.direct[0]
+                self.why[fn.key] = ("direct", line, what)
+                work.append(fn.key)
+        while work:
+            key = work.pop()
+            fn = self.funcs[key]
+            if self._is_allowlisted(fn):
+                continue  # sanctioned funnel: effect stops here
+            for caller, line in edges_rev.get(key, []):
+                if caller.key in self.why:
+                    continue
+                self.why[caller.key] = ("via", line, key)
+                work.append(caller.key)
+
+    def forces(self, key: FuncKey) -> bool:
+        return key in self.why
+
+    def chain(self, key: FuncKey) -> str:
+        """Human-readable forcing chain ending at the direct site."""
+        hops = []
+        cur = key
+        for _ in range(32):
+            why = self.why.get(cur)
+            if why is None:
+                break
+            if why[0] == "direct":
+                rel, qual = cur
+                hops.append(f"{qual}() forces via {why[2]} ({rel}:{why[1]})")
+                break
+            _, line, nxt = why
+            hops.append(f"{cur[1]}() [{cur[0]}:{line}]")
+            cur = nxt
+        return " -> ".join(hops)
+
+
+# -- passes -------------------------------------------------------------------
+
+
+@lint_pass
+def purity_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+
+    # BGT010 — intra-function syntax, hot files only
+    hot_files = []
+    for sf in ctx.files:
+        allow = cfg.purity_allowlist_for(sf.rel)
+        if allow is None or sf.tree is None:
+            continue
+        hot_files.append((sf, allow))
+        for line, msg in check_purity(
+            sf.tree, allow, cfg.purity_attrs, cfg.purity_names
+        ):
+            out.append(Finding("BGT010", sf.rel, line, msg))
+
+    # BGT011 — interprocedural: package call graph, report call sites in
+    # hot files whose resolved callee transitively forces
+    graph = CallGraph(ctx)
+    for sf, allow in hot_files:
+        mod = graph.by_rel.get(sf.rel)
+        if mod is None:
+            continue
+        for fn in mod.funcs.values():
+            if fn.name in allow:
+                continue
+            for line, tgt in graph.resolved.get(fn.key, []):
+                if graph._is_allowlisted(tgt) or not graph.forces(tgt.key):
+                    continue
+                out.append(Finding(
+                    "BGT011", sf.rel, line,
+                    f"interprocedural purity: {fn.key[1]}() reaches a "
+                    f"device->host-forcing helper: {graph.chain(tgt.key)} — "
+                    "route through an allowlisted flush funnel or make the "
+                    "helper non-blocking",
+                ))
+
+    # BGT012 — stale allowlist entries (AST lookup in the target file)
+    if cfg.project_checks:
+        for suffix, names in sorted(cfg.purity_allow.items()):
+            target = ctx.by_suffix(suffix)
+            if target is None:
+                path = ctx.root / suffix
+                if not path.exists():
+                    out.append(Finding(
+                        "BGT012", suffix, 0,
+                        f"PURITY_ALLOW covers {suffix!r} but the file does "
+                        "not exist — remove or update the entry "
+                        "(scripts/lint/config.py)",
+                    ))
+                    continue
+                # outside the linted path set: load directly
+                from ..core import load_file
+
+                target = load_file(path, ctx.root)
+            if target.tree is None:
+                continue
+            defined = {
+                n.name
+                for n in ast.walk(target.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for fname in sorted(names - defined):
+                out.append(Finding(
+                    "BGT012", suffix, 0,
+                    f"stale allowlist: PURITY_ALLOW[{suffix!r}] names "
+                    f"{fname!r} but no such function exists in the file — "
+                    "the allowlist rotted under a refactor "
+                    "(scripts/lint/config.py)",
+                ))
+    return out

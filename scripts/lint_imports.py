@@ -1,402 +1,49 @@
 #!/usr/bin/env python
-"""Fast lint gate for CI: unused imports, obvious bind errors, the
-hot-loop purity rule, the phase-timer catalog, and the metric-name <->
-docs-catalog cross-check (every registered metric must have a
-docs/observability.md table row, and vice versa).
+"""Thin shim over the determinism analyzer (``scripts/lint/``).
 
-Prefers ``pyflakes`` when it is importable (full undefined-name analysis);
-otherwise falls back to a stdlib-``ast`` checker that catches the highest
-value class of drift in a growing codebase — imports nobody uses anymore —
-plus duplicate function/class definitions in the same scope.  Zero
-third-party dependencies by design (the container forbids installs).
+The four checks that used to live here — unused imports, hot-loop purity,
+the phase-timer catalog, and the metric<->docs cross-check — are now rules
+``BGT001``/``BGT010``/``BGT02x``/``BGT03x`` of the framework, alongside
+interprocedural purity (``BGT011``) and the determinism-hazard rules
+(``BGT04x``).  See docs/static-analysis.md for the catalog.
 
-The purity lint runs in BOTH modes: the pipelined tick engine
-(docs/architecture.md "Tick pipeline") depends on the hot loop never forcing
-a device->host sync — one stray ``block_until_ready`` / ``device_get`` /
-eager ``.to_int`` in the dispatch path re-serializes host against device and
-silently voids the overlap, with no test failing.  Forcing reads are allowed
-only inside the allowlisted harvest/flush functions below.
+This file keeps two things working unchanged:
 
-    python scripts/lint_imports.py [paths...]   # default: package+tests+scripts
+- ``python scripts/lint_imports.py [paths...]`` — delegates to
+  ``python -m scripts.lint`` with the same arguments and exit semantics;
+- the module-level mirrors the test suite loads by file path
+  (``PHASE_CATALOG``, ``check_phases``, ``check_purity``) — now backed by
+  the framework, with the phase catalog extracted from
+  ``telemetry/phases.py`` by AST literal parsing instead of a
+  hand-maintained copy (tests/test_phases.py keeps the identity
+  assertion as a regression guard).
 """
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-DEFAULT_PATHS = ("bevy_ggrs_tpu", "tests", "scripts", "bench.py")
+_ROOT = Path(__file__).resolve().parent.parent
+if str(_ROOT) not in sys.path:
+    sys.path.insert(0, str(_ROOT))
 
-# re-export / intentional-import conventions that must not be flagged
-_ALLOW_UNUSED_IN = ("__init__.py",)
+from scripts.lint import main  # noqa: E402
+from scripts.lint.config import PURITY_ALLOW, PHASES_MODULE  # noqa: E402,F401
+from scripts.lint.rules.phases import (  # noqa: E402
+    check_phases as _check_phases,
+    extract_phase_catalog,
+)
+from scripts.lint.rules.purity import check_purity  # noqa: E402,F401
 
-# -- hot-loop purity --------------------------------------------------------
-# file (path suffix) -> functions allowed to force device->host reads
-PURITY_ALLOW = {
-    "bevy_ggrs_tpu/runner.py": {
-        "checksum",               # user-facing flush point (property)
-        "read_components",        # render readback (drains first)
-        "_drain_inflight",        # THE blocking point the others share
-        "_flush_session_checks",  # finish()/set_session flush
-    },
-    "bevy_ggrs_tpu/batch_runner.py": {
-        "lobby_checksum",         # user-facing flush point
-        "finish",                 # end-of-run flush
-    },
-    "bevy_ggrs_tpu/ops/batch.py": {
-        "harvest_shards",         # per-device metrics probe (bench/dryrun
-                                  # only — never called from the tick path)
-    },
-    "bevy_ggrs_tpu/session/p2p.py": {
-        "check_now",              # finish()/set_session flush hook
-        "_resolve_checksum",      # the one sanctioned force/peek funnel
-    },
-}
-# attribute accesses that force (or can force) a device sync
-PURITY_ATTRS = {"to_int", "block_until_ready", "device_get"}
-# bare-name calls that force
-PURITY_NAMES = {"checksum_to_int"}
-
-# -- tick-phase timer discipline --------------------------------------------
-# Mirror of bevy_ggrs_tpu.telemetry.phases.PHASES (stdlib-only: importing
-# the package pulls jax, which this gate must not do).  tests/test_phases.py
-# asserts the two stay identical.  Every ``.phase("<literal>")`` call in the
-# drivers must name a catalog phase (a typo would silently leak its time
-# into unattributed_ms) and must be a ``with``-statement context expression
-# (a bare call never runs __enter__/__exit__, so it times nothing).
-PHASE_CATALOG = {
-    "net_poll", "session_step", "stage_inputs", "wave_dispatch",
-    "readback_harvest", "rollback_load", "store_save",
-}
-PHASE_FILES = ("bevy_ggrs_tpu/runner.py", "bevy_ggrs_tpu/batch_runner.py")
-
-# -- metric-name <-> docs-catalog cross-check --------------------------------
-# Every metric the package/scripts register with a literal name must appear
-# in a `| metric | ... |` table of docs/observability.md, and every name the
-# docs catalog lists must still be registered somewhere — both directions,
-# so the catalog can neither rot nor silently under-document new families.
-# Tests are excluded (they register throwaway names on purpose).
-METRIC_CODE_PATHS = ("bevy_ggrs_tpu", "scripts", "bench.py")
-METRIC_DOCS = "docs/observability.md"
-# registry/shorthand entry points whose first positional arg is the name
-_METRIC_REG_ATTRS = {
-    "counter", "gauge", "histogram",
-    "bind_counter", "bind_gauge", "bind_histogram", "gauge_set",
-}
-# telemetry-module shorthands; gated on the receiver being `telemetry` so
-# unrelated `.count("x")` / `.observe(...)` methods never false-positive
-_METRIC_TELEMETRY_ATTRS = {"count", "observe", "gauge_set"}
-_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]{2,}$")
+# extracted from the package source — no jax import, nothing to mirror
+PHASE_CATALOG = extract_phase_catalog(_ROOT / PHASES_MODULE) or set()
 
 
-def _attr_root(node: ast.Attribute):
-    """Name at the root of a dotted/called access, e.g. ``registry().x`` or
-    ``a.b.c`` -> ``registry`` / ``a`` (None when the root is not a name)."""
-    inner = node.value
-    while isinstance(inner, (ast.Attribute, ast.Call)):
-        inner = inner.func if isinstance(inner, ast.Call) else inner.value
-    return inner.id if isinstance(inner, ast.Name) else None
-
-
-def collect_metric_names(tree: ast.AST) -> set:
-    """Metric names registered with a string literal anywhere in ``tree``."""
-    names = set()
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
-            continue
-        attr = node.func.attr
-        if attr in _METRIC_TELEMETRY_ATTRS:
-            if _attr_root(node.func) != "telemetry":
-                continue
-        elif attr not in _METRIC_REG_ATTRS:
-            continue
-        if not node.args:
-            continue
-        a0 = node.args[0]
-        # a conditional name picks one of two literals (runner.py's
-        # speculation hit/miss counter) — both are registered names
-        cands = [a0.body, a0.orelse] if isinstance(a0, ast.IfExp) else [a0]
-        for c in cands:
-            if isinstance(c, ast.Constant) and isinstance(c.value, str) \
-                    and _METRIC_NAME_RE.match(c.value):
-                names.add(c.value)
-    return names
-
-
-def docs_metric_names(md_text: str) -> set:
-    """Backticked names in the first column of every ``| metric | ... |``
-    table in the docs catalog."""
-    names = set()
-    in_table = False
-    for line in md_text.splitlines():
-        stripped = line.strip()
-        if not stripped.startswith("|"):
-            in_table = False
-            continue
-        cells = [c.strip() for c in stripped.strip("|").split("|")]
-        if not cells:
-            continue
-        if cells[0] == "metric":
-            in_table = True
-            continue
-        if in_table and not set(cells[0]) <= set("-: "):
-            names.update(re.findall(r"`([a-z][a-z0-9_]+)`", cells[0]))
-    return names
-
-
-def check_metric_docs(root: Path) -> list:
-    """Both-direction diff between code-registered metric names and the
-    docs/observability.md catalog; returns ``(path, message)`` problems."""
-    code_names = set()
-    for p in METRIC_CODE_PATHS:
-        for f in _iter_files([root / p]):
-            if "tests" in f.parts:
-                continue
-            try:
-                tree = ast.parse(f.read_text(), filename=str(f))
-            except SyntaxError:
-                continue  # the import lint reports it
-            code_names |= collect_metric_names(tree)
-    docs_path = root / METRIC_DOCS
-    if not docs_path.exists():
-        return [(str(docs_path), "metric catalog file missing")]
-    doc_names = docs_metric_names(docs_path.read_text())
-    problems = []
-    for name in sorted(code_names - doc_names):
-        problems.append((
-            str(docs_path),
-            f"metric {name!r} is registered in code but missing from the "
-            "docs catalog (add a `| metric | labels | meaning |` row)",
-        ))
-    for name in sorted(doc_names - code_names):
-        problems.append((
-            str(docs_path),
-            f"metric {name!r} is documented in the catalog but never "
-            "registered in code (stale row — remove or fix the name)",
-        ))
-    return problems
-
-
-def _purity_allowlist(path: Path):
-    """The allowlist for ``path`` if the purity lint covers it, else None."""
-    posix = path.as_posix()
-    for suffix, allow in PURITY_ALLOW.items():
-        if posix.endswith(suffix):
-            return allow
-    return None
-
-
-def check_purity(tree: ast.AST, allow: set) -> list:
-    """Return ``(line, message)`` for forcing reads outside ``allow``-listed
-    functions (attribute accesses count even un-called: holding a bound
-    ``.to_int`` and calling it later forces just the same)."""
-    problems = []
-
-    def walk(node, fn):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            fn = node.name
-        bad = None
-        if isinstance(node, ast.Attribute) and node.attr in PURITY_ATTRS:
-            bad = f".{node.attr}"
-        elif isinstance(node, ast.Name) and node.id in PURITY_NAMES:
-            bad = node.id
-        if bad is not None and fn not in allow:
-            problems.append((
-                node.lineno,
-                f"hot-loop purity: {bad} in {fn or '<module>'}() — forcing "
-                "device->host reads is allowed only in "
-                f"{sorted(allow)} (see docs/architecture.md tick pipeline)",
-            ))
-        for child in ast.iter_child_nodes(node):
-            walk(child, fn)
-
-    walk(tree, None)
-    return problems
-
-
-def check_phases(tree: ast.AST) -> list:
-    """Return ``(line, message)`` for ``.phase(...)`` misuse in a driver:
-    a non-literal or non-catalog phase name, or a call that is not a
-    ``with``-statement context expression (timing nothing)."""
-    problems = []
-    with_exprs = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.With, ast.AsyncWith)):
-            for item in node.items:
-                with_exprs.add(id(item.context_expr))
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "phase"
-        ):
-            continue
-        if (
-            len(node.args) != 1
-            or node.keywords
-            or not isinstance(node.args[0], ast.Constant)
-            or not isinstance(node.args[0].value, str)
-        ):
-            problems.append((
-                node.lineno,
-                "phase timer: .phase() takes one string literal "
-                "(dynamic names defeat the catalog lint)",
-            ))
-            continue
-        name = node.args[0].value
-        if name not in PHASE_CATALOG:
-            problems.append((
-                node.lineno,
-                f"phase timer: {name!r} is not in the phase catalog "
-                f"{sorted(PHASE_CATALOG)} — its time would silently land "
-                "in unattributed_ms (telemetry/phases.py)",
-            ))
-        if id(node) not in with_exprs:
-            problems.append((
-                node.lineno,
-                f"phase timer: .phase({name!r}) must be a with-statement "
-                "context expression — a bare call times nothing",
-            ))
-    return problems
-
-
-def _check_phases_file(path: Path) -> list:
-    posix = path.as_posix()
-    if not any(posix.endswith(s) for s in PHASE_FILES):
-        return []
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError:
-        return []  # the import lint reports the syntax error
-    return check_phases(tree)
-
-
-def _check_purity_file(path: Path) -> list:
-    allow = _purity_allowlist(path)
-    if allow is None:
-        return []
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError:
-        return []  # the import lint reports the syntax error
-    return check_purity(tree, allow)
-
-
-def _names_loaded(tree: ast.AST) -> set:
-    """Every bare name and attribute-root referenced anywhere in the tree."""
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # walk to the root of a dotted access (os.path.join -> os)
-            inner = node.value
-            while isinstance(inner, ast.Attribute):
-                inner = inner.value
-            if isinstance(inner, ast.Name):
-                used.add(inner.id)
-    # names referenced inside string annotations / __all__ entries count
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            used.add(node.value)
-    return used
-
-
-def _check_file(path: Path) -> list:
-    """Return ``(line, message)`` problems found in one file."""
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    problems = []
-    used = _names_loaded(tree)
-    allow_unused = path.name in _ALLOW_UNUSED_IN
-    lines = src.splitlines()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.Import, ast.ImportFrom)):
-            continue
-        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-            continue  # compiler directives, not bindings
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if "noqa" in line or allow_unused:
-            continue
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            bound = alias.asname or alias.name.split(".")[0]
-            if bound not in used and bound != "_":
-                problems.append(
-                    (node.lineno, f"unused import: {alias.asname or alias.name}")
-                )
-    # duplicate top-level def/class bindings in the same scope shadow silently
-    for scope in ast.walk(tree):
-        if not isinstance(scope, (ast.Module, ast.ClassDef)):
-            continue
-        seen = {}
-        for stmt in scope.body:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-                # any decorator exempts: @property/@x.setter pairs,
-                # @overload stacks, @pytest.fixture shadowing, ...
-                if stmt.name in seen and not stmt.decorator_list:
-                    problems.append(
-                        (stmt.lineno,
-                         f"duplicate definition of {stmt.name!r} "
-                         f"(first at line {seen[stmt.name]})")
-                    )
-                seen[stmt.name] = stmt.lineno
-    return problems
-
-
-def _iter_files(paths) -> list:
-    """Expand the path arguments into a sorted list of .py files."""
-    files = []
-    for p in paths:
-        p = Path(p)
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    return files
-
-
-def main(argv) -> int:
-    """Lint the given paths; return a non-zero exit code on any finding."""
-    paths = argv[1:] or list(DEFAULT_PATHS)
-    files = _iter_files(paths)
-    # the purity + phase-timer lints run regardless of which import checker
-    # is available
-    pure_bad = 0
-    for f in files:
-        for lineno, msg in _check_purity_file(f):
-            print(f"{f}:{lineno}: {msg}")
-            pure_bad += 1
-        for lineno, msg in _check_phases_file(f):
-            print(f"{f}:{lineno}: {msg}")
-            pure_bad += 1
-    for where, msg in check_metric_docs(Path(__file__).resolve().parent.parent):
-        print(f"{where}: {msg}")
-        pure_bad += 1
-    try:
-        from pyflakes.api import checkPath
-        from pyflakes.reporter import Reporter
-
-        rep = Reporter(sys.stdout, sys.stderr)
-        bad = sum(checkPath(str(f), rep) for f in files)
-        print(f"lint (pyflakes + purity + phases + metrics): {len(files)} files, "
-              f"{bad + pure_bad} problems")
-        return 1 if bad + pure_bad else 0
-    except ImportError:
-        pass
-    bad = 0
-    for f in files:
-        for lineno, msg in _check_file(f):
-            print(f"{f}:{lineno}: {msg}")
-            bad += 1
-    print(f"lint (stdlib ast + purity + phases + metrics): {len(files)} files, "
-          f"{bad + pure_bad} problems")
-    return 1 if bad + pure_bad else 0
+def check_phases(tree):
+    """Old-API adapter: ``(line, message)`` pairs against the extracted
+    catalog (the framework's variant also reports which names were used)."""
+    problems, _used = _check_phases(tree, PHASE_CATALOG)
+    return [(line, msg) for line, msg, _rid in problems]
 
 
 if __name__ == "__main__":
-    raise SystemExit(main(sys.argv))
+    raise SystemExit(main(sys.argv[1:]))

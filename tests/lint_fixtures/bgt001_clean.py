@@ -1,0 +1,4 @@
+"""BGT001 clean: every import is used."""
+import json
+
+print(json.dumps({}))

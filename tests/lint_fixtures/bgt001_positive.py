@@ -1,0 +1,5 @@
+"""BGT001 positive: an import nobody uses."""
+import os
+import json
+
+print(json.dumps({}))

@@ -1,0 +1,11 @@
+"""BGT002 clean: decorated pairs are exempt by design."""
+
+
+class C:
+    @property
+    def v(self):
+        return self._v
+
+    @v.setter
+    def v(self, x):
+        self._v = x

@@ -1,0 +1,9 @@
+"""BGT002 positive: a redefinition silently shadows the first."""
+
+
+def advance(x):
+    return x + 1
+
+
+def advance(x):
+    return x + 2

@@ -1,0 +1,10 @@
+"""BGT002 suppressed."""
+
+
+def advance(x):
+    return x + 1
+
+
+# bgt: ignore[BGT002]: intentional platform-specific override below
+def advance(x):
+    return x + 2

@@ -1,0 +1,2 @@
+"""BGT004 clean: a well-formed suppression of a real rule."""
+import os  # bgt: ignore[BGT001]: intentional

@@ -1,0 +1,2 @@
+"""BGT004 positive: a typo'd rule id in an ignore comment."""
+X = 1  # bgt: ignore[BGT999]

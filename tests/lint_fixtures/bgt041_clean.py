@@ -1,0 +1,9 @@
+"""BGT041 clean: all randomness derives from explicit seeds."""
+import random
+import numpy as np
+
+
+def sample(seed: int):
+    rng = np.random.default_rng(seed)
+    r = random.Random(seed)
+    return rng.uniform(), r.random()

@@ -1,0 +1,10 @@
+"""BGT041 positive: process-global RNG in all three shapes."""
+import random
+import numpy as np
+
+
+def jitter():
+    a = random.random()
+    b = np.random.uniform(0.0, 1.0)
+    rng = np.random.default_rng()
+    return a + b + rng.uniform()

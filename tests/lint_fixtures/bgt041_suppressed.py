@@ -1,0 +1,7 @@
+"""BGT041 suppressed: justified host-side-only global draw."""
+import random
+
+
+def nonce():
+    # bgt: ignore[BGT041]: handshake nonce — host-side protocol only
+    return random.getrandbits(32)

@@ -1,0 +1,9 @@
+"""BGT042 clean: sorted() pins the order before accumulation."""
+import numpy as np
+
+
+def accumulate(names):
+    total = sum(sorted({1.5, 2.5, 3.5}))
+    arr = np.asarray(sorted({0.1, 0.2}))
+    tag = ",".join(sorted(set(names)))
+    return total, arr, tag

@@ -1,0 +1,9 @@
+"""BGT042 positive: set iteration feeding order-sensitive sinks."""
+import numpy as np
+
+
+def accumulate(names):
+    total = sum(w for w in {1.5, 2.5, 3.5})
+    arr = np.asarray({0.1, 0.2})
+    tag = ",".join(set(names))
+    return total, arr, tag

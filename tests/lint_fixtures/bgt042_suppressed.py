@@ -1,0 +1,6 @@
+"""BGT042 suppressed: order provably irrelevant (exact ints)."""
+
+
+def count(flags):
+    # bgt: ignore[BGT042]: exact integer sum — order cannot change the value
+    return sum(set(flags))

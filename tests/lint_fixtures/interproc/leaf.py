@@ -1,0 +1,2 @@
+def pull(ref):
+    return ref.block_until_ready()

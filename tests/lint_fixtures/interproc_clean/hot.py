@@ -1,0 +1,6 @@
+"""Clean driver: the helper chain never forces."""
+from .helpers import grab
+
+
+def tick(ref):
+    return grab(ref)

@@ -1,0 +1,5 @@
+from .leaf import pull
+
+
+def grab(ref):
+    return pull(ref)

@@ -1,0 +1,3 @@
+def pull(ref):
+    # bgt: ignore[BGT011]: guarded — only called after readiness is polled
+    return ref.block_until_ready()

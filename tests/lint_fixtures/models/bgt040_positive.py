@@ -1,0 +1,10 @@
+"""BGT040 positive: wall-clock reads inside sim-code functions."""
+import time
+import datetime
+
+
+def step(world):
+    t = time.time()
+    m = time.monotonic()
+    now = datetime.datetime.now()
+    return t + m + now.timestamp()

@@ -1,0 +1,5 @@
+"""BGT043 clean: no host callbacks in the step."""
+
+
+def step(world, x):
+    return world, x + 1

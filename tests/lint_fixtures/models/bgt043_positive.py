@@ -1,0 +1,10 @@
+"""BGT043 positive: host callbacks inside sim code."""
+import jax
+from jax.experimental import io_callback
+
+
+def step(world, x):
+    jax.debug.print("x={}", x)
+    io_callback(print, None, x)
+    jax.pure_callback(lambda v: v, x, x)
+    return world

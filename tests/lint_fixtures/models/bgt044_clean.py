@@ -1,0 +1,6 @@
+"""BGT044 clean: new state via dataclasses.replace."""
+import dataclasses
+
+
+def step(world, x):
+    return dataclasses.replace(world, pos=world.pos + x)

@@ -1,0 +1,8 @@
+"""BGT044 positive: in-place mutation of the frozen world."""
+
+
+def step(world, x):
+    world.pos = x
+    world.comps["pos"] = x
+    world.vel += x
+    return world

@@ -1,0 +1,7 @@
+"""BGT044 suppressed: a sanctioned scratch-field write."""
+
+
+def step(world, x):
+    # bgt: ignore[BGT044]: scratch cache field, excluded from snapshots
+    world._scratch = x
+    return world

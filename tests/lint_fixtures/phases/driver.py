@@ -1,0 +1,24 @@
+"""Fixture driver exercising BGT020/BGT021 against phases.py's catalog."""
+
+
+class _T:
+    def phase(self, name):
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def run(t: _T, dynamic: str):
+    with t.phase("inputs"):
+        pass
+    with t.phase("advance"):
+        pass
+    with t.phase("typo_phase"):      # BGT020: not in the catalog
+        pass
+    with t.phase(dynamic):           # BGT020: not one string literal
+        pass
+    t.phase("checksum")              # BGT021: bare call, times nothing

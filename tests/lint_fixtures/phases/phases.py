@@ -1,0 +1,8 @@
+"""Fixture phase catalog (AST-extracted by the lint, never imported)."""
+
+PHASES = (
+    "inputs",
+    "advance",
+    "checksum",
+    "never_timed",
+)

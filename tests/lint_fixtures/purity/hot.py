@@ -1,0 +1,14 @@
+"""BGT010 fixtures: forcing syntax in and out of the allowlist."""
+
+
+def tick(ref):
+    return ref.block_until_ready()
+
+
+def also_bad(ref):
+    # bgt: ignore[BGT010]: guarded non-blocking poll in the real code
+    return ref.to_int()
+
+
+def sanctioned(ref):
+    return ref.device_get()

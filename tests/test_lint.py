@@ -1,0 +1,411 @@
+"""Determinism-analyzer tests: fixture corpus, interprocedural regression,
+and the repo-at-HEAD-lints-clean gate.
+
+The corpus under ``tests/lint_fixtures/`` carries a true-positive, a
+suppressed, and a clean fixture per rule; these tests parameterize over
+them so the analyzer is tested like product code.  The interprocedural
+test is the acceptance criterion for BGT011: the two-deep forcing chain
+(``tick -> grab -> pull``) that the old intra-function ``check_purity``
+provably misses (it returns no problems for ``hot.py``) is flagged at the
+call site with the full witness chain.
+
+No jax import anywhere in this module — the analyzer is stdlib-only and
+so are its tests.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from scripts.lint import RULES, run  # noqa: E402
+from scripts.lint.config import Config  # noqa: E402
+from scripts.lint.core import (  # noqa: E402
+    load_baseline,
+    parse_suppressions,
+    write_baseline,
+)
+from scripts.lint.rules.docs import docs_rule_ids  # noqa: E402
+from scripts.lint.rules.metrics import (  # noqa: E402
+    collect_metric_names,
+    docs_metric_names,
+)
+from scripts.lint.rules.phases import extract_phase_catalog  # noqa: E402
+from scripts.lint.rules.purity import check_purity  # noqa: E402
+
+FIXTURES = ROOT / "tests" / "lint_fixtures"
+
+# string-literal copies of the ignore syntax are assembled from halves so
+# the analyzer's line-based comment scan never sees the pattern in THIS
+# file's source
+_IG = "# bgt: " + "ignore"
+
+
+def lint_paths(paths, **overrides):
+    """Run the framework over explicit fixture paths with a quiet config
+    (project-level cross-checks off unless a test turns them on)."""
+    overrides.setdefault("project_checks", False)
+    cfg = Config(**overrides)
+    findings, _files = run([str(p) for p in paths], root=ROOT, config=cfg)
+    return findings
+
+
+def only(findings, rule_id):
+    return [f for f in findings if f.rule == rule_id]
+
+
+# -- file-scoped rule triples -------------------------------------------------
+
+# (rule id, fixture stem, expected positive count)
+TRIPLES = [
+    ("BGT001", "bgt001", 1),
+    ("BGT002", "bgt002", 1),
+    ("BGT041", "bgt041", 3),
+    ("BGT042", "bgt042", 3),
+    ("BGT040", "models/bgt040", 3),
+    ("BGT043", "models/bgt043", 3),
+    ("BGT044", "models/bgt044", 3),
+]
+
+
+@pytest.mark.parametrize("rule_id,stem,n_pos", TRIPLES,
+                         ids=[t[0] for t in TRIPLES])
+def test_fixture_positive_fires(rule_id, stem, n_pos):
+    hits = only(lint_paths([FIXTURES / f"{stem}_positive.py"]), rule_id)
+    assert len(hits) == n_pos, [f.as_dict() for f in hits]
+    assert all(not f.suppressed for f in hits)
+    assert all(f.severity == "error" for f in hits)
+
+
+@pytest.mark.parametrize("rule_id,stem,n_pos", TRIPLES,
+                         ids=[t[0] for t in TRIPLES])
+def test_fixture_suppression_respected(rule_id, stem, n_pos):
+    hits = only(lint_paths([FIXTURES / f"{stem}_suppressed.py"]), rule_id)
+    assert hits, "the suppressed fixture must still trip the rule"
+    assert all(f.suppressed for f in hits)
+    assert all(f.suppress_reason for f in hits), \
+        "fixture suppressions all carry a justification"
+
+
+@pytest.mark.parametrize("rule_id,stem,n_pos", TRIPLES,
+                         ids=[t[0] for t in TRIPLES])
+def test_fixture_clean_is_clean(rule_id, stem, n_pos):
+    assert only(lint_paths([FIXTURES / f"{stem}_clean.py"]), rule_id) == []
+
+
+def test_bgt003_syntax_error():
+    hits = only(lint_paths([FIXTURES / "bgt003_positive.py"]), "BGT003")
+    assert len(hits) == 1 and not hits[0].suppressed
+
+
+def test_bgt004_unknown_suppression_id():
+    hits = only(lint_paths([FIXTURES / "bgt004_positive.py"]), "BGT004")
+    assert len(hits) == 1
+    assert "BGT999" in hits[0].message
+    assert only(lint_paths([FIXTURES / "bgt004_clean.py"]), "BGT004") == []
+
+
+# -- hot-loop purity: intra-function (BGT010) ---------------------------------
+
+PURITY_CFG = dict(
+    purity_allow={"lint_fixtures/purity/hot.py": {"sanctioned"}},
+)
+
+
+def test_bgt010_positive_suppressed_and_allowlisted():
+    findings = lint_paths([FIXTURES / "purity" / "hot.py"], **PURITY_CFG)
+    hits = only(findings, "BGT010")
+    assert len(hits) == 2, [f.as_dict() for f in hits]
+    live = [f for f in hits if not f.suppressed]
+    assert len(live) == 1 and "tick" in live[0].message
+    gone = [f for f in hits if f.suppressed]
+    assert len(gone) == 1 and "also_bad" in gone[0].message
+    # the allowlisted funnel's own .device_get is never flagged
+    src = (FIXTURES / "purity" / "hot.py").read_text().splitlines()
+    sanction_line = next(i for i, ln in enumerate(src, 1) if ".device_get" in ln)
+    assert all(f.line != sanction_line for f in hits)
+
+
+# -- hot-loop purity: interprocedural (BGT011) --------------------------------
+
+
+def _interproc_paths(pkg):
+    d = FIXTURES / pkg
+    return [d / "__init__.py", d / "hot.py", d / "helpers.py", d / "leaf.py"]
+
+
+def _interproc_cfg(pkg):
+    return dict(
+        package_dir=f"tests/lint_fixtures/{pkg}",
+        purity_allow={f"lint_fixtures/{pkg}/hot.py": set()},
+    )
+
+
+def test_bgt011_catches_two_deep_chain_the_old_check_misses():
+    """THE acceptance criterion: hot.py has no forcing syntax, so the old
+    intra-function rule is blind to it; the call graph flags the call site
+    with the full tick -> grab -> pull witness chain."""
+    import ast
+
+    hot = FIXTURES / "interproc" / "hot.py"
+    assert check_purity(ast.parse(hot.read_text()), allow=set()) == [], \
+        "the old intra-function check must provably miss this fixture"
+
+    findings = lint_paths(_interproc_paths("interproc"),
+                          **_interproc_cfg("interproc"))
+    hits = only(findings, "BGT011")
+    assert len(hits) == 1, [f.as_dict() for f in findings]
+    f = hits[0]
+    assert f.path.endswith("interproc/hot.py") and not f.suppressed
+    # the message carries the whole chain down to the direct forcing site
+    for fragment in ("tick", "grab", "pull", "block_until_ready", "leaf.py"):
+        assert fragment in f.message, f.message
+    # and no BGT010 anywhere: there is no forcing syntax in the hot file
+    assert only(findings, "BGT010") == []
+
+
+def test_bgt011_seed_line_suppression_sanctions_every_caller():
+    findings = lint_paths(_interproc_paths("interproc_suppressed"),
+                          **_interproc_cfg("interproc_suppressed"))
+    assert only(findings, "BGT011") == [], \
+        "suppressing at the seed (forcing) line must clear the whole chain"
+
+
+def test_bgt011_clean_chain_is_clean():
+    findings = lint_paths(_interproc_paths("interproc_clean"),
+                          **_interproc_cfg("interproc_clean"))
+    assert only(findings, "BGT011") == []
+
+
+# -- stale-allowlist meta-lint (BGT012) ---------------------------------------
+
+
+def test_bgt012_flags_rotted_allowlist_entry():
+    findings = lint_paths(
+        [FIXTURES / "purity" / "hot.py"],
+        purity_allow={"lint_fixtures/purity/hot.py": {"sanctioned", "ghost_fn"}},
+        project_checks=True,
+    )
+    hits = only(findings, "BGT012")
+    assert len(hits) == 1 and "ghost_fn" in hits[0].message
+    # existing entries are not flagged
+    assert "sanctioned" not in hits[0].message
+
+
+def test_bgt012_flags_missing_target_file():
+    findings = lint_paths(
+        [FIXTURES / "purity" / "hot.py"],
+        purity_allow={"lint_fixtures/purity/gone.py": {"whatever"}},
+        project_checks=True,
+    )
+    hits = only(findings, "BGT012")
+    assert len(hits) == 1 and "does not exist" in hits[0].message
+
+
+# -- tick-phase discipline (BGT020/021/022) -----------------------------------
+
+PHASES_CFG = dict(
+    phases_module="tests/lint_fixtures/phases/phases.py",
+    phase_files=("lint_fixtures/phases/driver.py",),
+    purity_allow={},
+    project_checks=True,  # the reverse (stale-catalog) check needs it
+)
+
+
+def test_phase_rules_on_fixture_driver():
+    findings = lint_paths([FIXTURES / "phases" / "driver.py"], **PHASES_CFG)
+    bgt020 = only(findings, "BGT020")
+    assert len(bgt020) == 2
+    assert any("typo_phase" in f.message for f in bgt020)
+    assert any("one string literal" in f.message for f in bgt020)
+    bgt021 = only(findings, "BGT021")
+    assert len(bgt021) == 1 and "checksum" in bgt021[0].message
+    stale = only(findings, "BGT022")
+    assert len(stale) == 1 and "never_timed" in stale[0].message
+
+
+def test_phase_reverse_check_skipped_on_partial_corpus():
+    """A partial-path run must not call a phase stale just because the
+    driver that times it was not linted."""
+    cfg = dict(PHASES_CFG)
+    cfg["phase_files"] = ("lint_fixtures/phases/driver.py",
+                          "lint_fixtures/phases/other_driver.py")
+    findings = lint_paths([FIXTURES / "phases" / "driver.py"], **cfg)
+    assert only(findings, "BGT022") == []
+
+
+def test_extract_phase_catalog(tmp_path):
+    cat = extract_phase_catalog(FIXTURES / "phases" / "phases.py")
+    assert cat == {"inputs", "advance", "checksum", "never_timed"}
+    assert extract_phase_catalog(tmp_path / "missing.py") is None
+    bad = tmp_path / "dynamic.py"
+    bad.write_text("PHASES = tuple(x for x in names)\n")
+    assert extract_phase_catalog(bad) is None
+
+
+def test_bgt022_on_unextractable_catalog(tmp_path):
+    findings = lint_paths(
+        [FIXTURES / "bgt001_clean.py"],
+        phases_module="tests/lint_fixtures/phases/no_such_catalog.py",
+        purity_allow={},
+        project_checks=True,
+    )
+    hits = only(findings, "BGT022")
+    assert len(hits) == 1 and "AST literal parsing" in hits[0].message
+
+
+def test_real_catalog_extracts_and_matches_package():
+    """The real telemetry/phases.py catalog must stay AST-extractable —
+    that is the contract replacing the old hand-mirrored copy."""
+    cat = extract_phase_catalog(ROOT / "bevy_ggrs_tpu/telemetry/phases.py")
+    assert cat and "session_step" in cat
+
+
+# -- metric and rule docs cross-checks (BGT03x / BGT05x) ----------------------
+
+
+def test_metric_name_collection_and_docs_parse():
+    import ast
+
+    tree = ast.parse(
+        "reg.counter('ticks_total')\n"
+        "reg.bind_gauge('depth_now', lobby=3)\n"
+        "telemetry.count('rollbacks_total')\n"
+        "other.count('not_a_metric')\n"  # non-telemetry receiver: ignored
+    )
+    assert collect_metric_names(tree) == {
+        "ticks_total", "depth_now", "rollbacks_total",
+    }
+    md = (
+        "| metric | labels | meaning |\n"
+        "|--------|--------|---------|\n"
+        "| `ticks_total` | - | ticks |\n"
+        "\nprose mentioning `not_in_a_table`\n"
+    )
+    assert docs_metric_names(md) == {"ticks_total"}
+
+
+def test_bgt031_skipped_on_partial_corpus():
+    """A single-file run must not call every documented metric stale just
+    because the files registering them were not linted (the same guard as
+    the BGT022 reverse check)."""
+    findings = lint_paths([FIXTURES / "bgt001_clean.py"],
+                          purity_allow={}, project_checks=True)
+    assert only(findings, "BGT031") == []
+
+
+def test_bgt030_and_bgt031_on_synthetic_tree(tmp_path):
+    """Both directions fire against a synthetic repo root whose corpus IS
+    complete (the package __init__ is linted)."""
+    pkg = tmp_path / "bevy_ggrs_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "def setup(reg):\n"
+        "    reg.counter('undocumented_total')\n"
+        "    reg.gauge('documented_now')\n"
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| metric | labels | meaning |\n"
+        "|--------|--------|---------|\n"
+        "| `documented_now` | - | fine |\n"
+        "| `ghost_metric` | - | stale |\n"
+    )
+    cfg = Config(purity_allow={}, project_checks=True,
+                 phases_module="no/such/phases.py")
+    findings, _files = run([str(pkg / "__init__.py")], root=tmp_path,
+                           config=cfg)
+    b30 = only(findings, "BGT030")
+    assert len(b30) == 1 and "undocumented_total" in b30[0].message
+    b31 = only(findings, "BGT031")
+    assert len(b31) == 1 and "ghost_metric" in b31[0].message
+
+
+def test_rule_docs_catalog_matches_registry_exactly():
+    """docs/static-analysis.md documents exactly the registered rule set —
+    the human-readable half of the BGT050/BGT051 gate."""
+    ids = docs_rule_ids((ROOT / "docs/static-analysis.md").read_text())
+    assert ids == set(RULES)
+
+
+# -- suppression parsing ------------------------------------------------------
+
+
+def test_parse_suppressions_same_line_and_block():
+    src = (
+        "x = compute()  " + _IG + "[BGT001]: same-line reason\n"
+        + _IG + "[BGT042]: a standalone comment covers\n"
+        "# the whole block below it\n"
+        "y = sum(stuff)\n"
+    )
+    covers, unknown = parse_suppressions(src)
+    assert unknown == []
+    assert covers[1]["BGT001"] == "same-line reason"
+    # the standalone comment on line 2 covers lines 2-4 (through the block
+    # to the first code line)
+    for line in (2, 3, 4):
+        assert covers[line]["BGT042"] == "a standalone comment covers"
+    assert "BGT042" not in covers.get(1, {})
+
+
+def test_parse_suppressions_unknown_id_reported():
+    src = "x = 1  " + _IG + "[BGT998, BGT001]\n"
+    covers, unknown = parse_suppressions(src)
+    assert unknown == [(1, "BGT998")]
+    assert covers[1] == {"BGT001": ""}
+
+
+# -- baseline round-trip ------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = lint_paths([FIXTURES / "bgt041_positive.py"])
+    live = [f for f in findings if not f.suppressed]
+    assert live
+    bl = tmp_path / "baseline.json"
+    write_baseline(bl, findings)
+    known = load_baseline(bl)
+    assert {f.fingerprint() for f in live} == known
+    # fingerprints are line-number-free on purpose
+    assert all(len(fp) == 3 for fp in known)
+
+
+# -- the gate itself ----------------------------------------------------------
+
+
+def test_repo_at_head_lints_clean_and_json_report(tmp_path):
+    """`python -m scripts.lint` exits 0 at HEAD and the JSON report has the
+    documented shape — the exact invocation scripts/check.sh gates on."""
+    report_path = tmp_path / "lint_report.json"
+    res = subprocess.run(
+        [sys.executable, "-m", "scripts.lint", "--json", str(report_path)],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    report = json.loads(report_path.read_text())
+    assert report["version"] == 1
+    assert report["counts"]["errors"] == 0
+    assert report["counts"]["findings"] == 0
+    assert {r["id"] for r in report["rules"]} == set(RULES)
+    for f in report["findings"]:  # only suppressed ones remain at HEAD
+        assert f["suppressed"] and f["suppress_reason"]
+        assert {"rule", "name", "severity", "path", "line", "message"} \
+            <= set(f)
+
+
+def test_shim_cli_still_works():
+    """`python scripts/lint_imports.py` (the pre-framework invocation)
+    delegates to the framework with the same exit semantics."""
+    res = subprocess.run(
+        [sys.executable, "scripts/lint_imports.py"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "lint:" in res.stdout
