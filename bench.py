@@ -675,7 +675,8 @@ PIPELINE_MIN_SPEEDUP = 1.15
 
 
 def _make_p2p_pair(pipelined, tag, inputs=None, latency_hops=None,
-                   input_delay=2, entities=PIPELINE_ENTITIES):
+                   input_delay=2, entities=PIPELINE_ENTITIES,
+                   **runner_kw):
     """Build a two-runner p2p loopback pair over ``ChannelNetwork``.
 
     Shared by :func:`stage_pipeline` and :func:`stage_netstats`.  ``inputs``
@@ -712,6 +713,7 @@ def _make_p2p_pair(pipelined, tag, inputs=None, latency_hops=None,
                 else (lambda handles: {h: np.uint8(0) for h in handles}))
         runners.append(GgrsRunner(
             app, session, read_inputs=read, pipeline=pipelined,
+            **runner_kw,
         ))
     for _ in range(500):
         net.deliver()
@@ -864,6 +866,120 @@ def stage_pipeline():
             "median of per-round pipe/sync ratios; per-arm ticks/s = "
             "trimmed mean over rounds (drop 1 min + 1 max)"),
         "platform": platform,
+    }
+
+
+UPLOADS_TICKS = 150
+UPLOADS_WARM = 40
+MEGASTEP_N = 8
+MEGASTEP_FLUSHES = 16
+
+
+def stage_uploads():
+    """Host->device upload census: the packed single-upload tick and the
+    megastep N-tick flush (docs/dispatch_floor.md "Packed uploads" /
+    docs/architecture.md "Megastep").
+
+    Arm 1 is the steady predicted p2p pair from ``stage_pipeline``: with
+    constant inputs every tick is one fused advance, so the packed staging
+    path must feed it with exactly ONE upload (prefix row + payload rows in
+    one int8 buffer) — the pre-packing driver issued three (inputs, status,
+    start-frame scalar).  Arm 2 is the same pair with
+    ``coalesce_frames=8, megastep=True``: a flush owing exactly 8 frames
+    must retire as ONE dispatch fed by ONE upload (the device-resident
+    snapshot ring absorbs the loads).  Frame-advantage throttling makes a
+    few flushes owe 7 or 9; those are excluded from the gate but counted.
+
+    HARD GATES (raise -> nonzero exit):
+
+    1. packed steady state — host uploads == device dispatches == frames
+       advanced over the measured window (1 upload + 1 dispatch per tick);
+    2. megastep — every flush owing exactly N frames cost exactly 1
+       dispatch + 1 upload, and at least half the flushes were exact.
+
+    ``BGT_BENCH_SMOKE=1`` shrinks the windows; both gates stay armed."""
+    jax = _stage_setup()
+
+    smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
+    ticks = 50 if smoke else UPLOADS_TICKS
+    flushes = 8 if smoke else MEGASTEP_FLUSHES
+
+    # -- arm 1: packed per-tick census -----------------------------------
+    net, runners = _make_p2p_pair(True, "upl")
+    dt = 1.0 / runners[0].app.fps
+    _slice_ticks(jax, net, runners, UPLOADS_WARM, dt)
+    r0 = runners[0]
+    if not r0.stats()["packed"]:
+        raise RuntimeError("uploads gate: driver did not take the packed "
+                           "staging path")
+    d0, u0, f0 = (r0.device_dispatches, r0.stats()["host_uploads"], r0.frame)
+    b0 = r0.stats()["packed_upload_bytes"]
+    _slice_ticks(jax, net, runners, ticks, dt)
+    st = r0.stats()
+    packed_d = r0.device_dispatches - d0
+    packed_u = st["host_uploads"] - u0
+    packed_f = r0.frame - f0
+    bytes_per_tick = (st["packed_upload_bytes"] - b0) / max(packed_f, 1)
+    for r in runners:
+        r.finish()
+    if not (packed_d == packed_u == packed_f and packed_f > 0):
+        raise RuntimeError(
+            f"uploads gate: steady packed tick census broke — {packed_f} "
+            f"frames took {packed_d} dispatches and {packed_u} uploads "
+            "(required: 1 + 1 per frame)"
+        )
+
+    # -- arm 2: megastep flush census -------------------------------------
+    net_m, ms_runners = _make_p2p_pair(
+        True, "ms", coalesce_frames=MEGASTEP_N, megastep=True,
+    )
+    m0 = ms_runners[0]
+    for _ in range(6):  # settle: predictions confirmed, rings warm
+        _slice_ticks(jax, net_m, ms_runners, 1, MEGASTEP_N * dt)
+    exact = 0
+    total_d = total_u = total_f = 0
+    for _ in range(flushes):
+        d0, u0, f0 = (m0.device_dispatches, m0.stats()["host_uploads"],
+                      m0.frame)
+        _slice_ticks(jax, net_m, ms_runners, 1, MEGASTEP_N * dt)
+        fd = m0.frame - f0
+        dd = m0.device_dispatches - d0
+        ud = m0.stats()["host_uploads"] - u0
+        total_d += dd
+        total_u += ud
+        total_f += fd
+        if fd == MEGASTEP_N:
+            exact += 1
+            if dd != 1 or ud != 1:
+                raise RuntimeError(
+                    f"uploads gate: a megastep flush owing exactly "
+                    f"{MEGASTEP_N} frames cost {dd} dispatches and {ud} "
+                    "uploads (required: 1 + 1)"
+                )
+    ms_stats = m0.stats()
+    for r in ms_runners:
+        r.finish()
+    if exact < flushes // 2:
+        raise RuntimeError(
+            f"uploads gate: only {exact}/{flushes} megastep flushes owed "
+            f"exactly {MEGASTEP_N} frames — the cadence never settled, the "
+            "census is void"
+        )
+    return {
+        "uploads_per_tick_packed": round(packed_u / packed_f, 3),
+        "dispatches_per_tick_packed": round(packed_d / packed_f, 3),
+        "packed_upload_bytes_per_tick": round(bytes_per_tick, 1),
+        "megastep_frames_per_dispatch": round(total_f / max(total_d, 1), 2),
+        "megastep_uploads_per_flush": round(total_u / flushes, 2),
+        "megastep_exact_flushes": exact,
+        "megastep_flushes": flushes,
+        "megastep_n": MEGASTEP_N,
+        "megastep_fused_ring_loads": ms_stats["fused_ring_loads"],
+        "uploads_rep_policy": (
+            f"steady p2p census over {ticks} ticks after {UPLOADS_WARM} "
+            f"warm; megastep census over {flushes} x {MEGASTEP_N}-frame "
+            "flushes, gate on exactly-N flushes only"),
+        "platform": jax.devices()[0].platform,
     }
 
 
@@ -1052,6 +1168,7 @@ STAGES = {
     "layouts": (stage_layouts, 420),
     "telemetry": (stage_telemetry, 420),
     "pipeline": (stage_pipeline, 600),
+    "uploads": (stage_uploads, 420),
     "netstats": (stage_netstats, 420),
 }
 
@@ -1332,10 +1449,11 @@ def orchestrate():
 
 
 def smoke():
-    """CI smoke: the batched + sharded + netstats stages only, 1 rep, small
-    iter counts — seconds, not minutes — with every hard gate fully armed
-    (a dispatch-count regression in either executor, a broken
-    rollback-cause invariant, or a sampler-cost regression fails this run).
+    """CI smoke: the batched + sharded + netstats + uploads stages only,
+    1 rep, small iter counts — seconds, not minutes — with every hard gate
+    fully armed (a dispatch-count regression in either executor, a broken
+    rollback-cause invariant, a sampler-cost regression, or an extra
+    host->device upload on the packed/megastep paths fails this run).
     The sharded stage runs under forced 8-virtual-device CPU so the mesh
     path is exercised even on single-chip hosts; netstats runs on CPU (its
     gates are host-loop properties, not device throughput).  Wired into
@@ -1365,19 +1483,28 @@ def smoke():
     if netstats is None:
         print(f"bench smoke FAILED (netstats stage): {err}", file=sys.stderr)
         sys.exit(1)
+    uploads, err = _run_stage(
+        "uploads", timeout_s=300, force_cpu=True,
+        extra_env={"BGT_BENCH_SMOKE": "1"},
+    )
+    if uploads is None:
+        print(f"bench smoke FAILED (uploads stage): {err}", file=sys.stderr)
+        sys.exit(1)
     print(json.dumps({"smoke": "ok", **result,
                       "sharded": {k: v for k, v in sharded.items()
                                   if k != "platform"},
                       "netstats": {k: v for k, v in netstats.items()
-                                   if k != "platform"}}))
+                                   if k != "platform"},
+                      "uploads": {k: v for k, v in uploads.items()
+                                  if k != "platform"}}))
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", choices=sorted(STAGES), default=None)
     ap.add_argument("--smoke", action="store_true",
-                    help="batched + sharded + netstats stages only, 1 rep, "
-                         "all hard gates armed")
+                    help="batched + sharded + netstats + uploads stages "
+                         "only, 1 rep, all hard gates armed")
     args = ap.parse_args()
     if args.stage:
         from bevy_ggrs_tpu.utils.platform import apply_platform_env

@@ -56,11 +56,18 @@ def bench_synctest(n_entities=2000, ticks=150, check_distance=7):
         for _ in range(n):
             runner.tick()
 
+    d0, u0 = runner.device_dispatches, runner.stats()["host_uploads"]
     med, spread = _timed_passes(run, ticks)
+    st = runner.stats()
     print(json.dumps({
         "metric": f"driver_synctest_ticks_per_sec_{n_entities}ent_cd{check_distance}",
         "value": round(med, 1), "unit": "ticks/s",
         "spread": round(spread, 3), "passes": PASSES,
+        # timed-region upload census: the packed path holds this at one
+        # upload per dispatch (the pre-packing driver issued three)
+        "dispatches": runner.device_dispatches - d0,
+        "host_uploads": st["host_uploads"] - u0,
+        "packed": st["packed"],
     }))
 
 
@@ -98,12 +105,18 @@ def bench_p2p_channel(n_entities=2000, ticks=300):
             for r in runners:
                 r.update(1 / 60)
 
+    d0, u0 = (runners[0].device_dispatches,
+              runners[0].stats()["host_uploads"])
     med, spread = _timed_passes(run, ticks)
+    st = runners[0].stats()
     print(json.dumps({
         "metric": f"driver_p2p_pair_ticks_per_sec_{n_entities}ent",
         "value": round(med, 1), "unit": "ticks/s",
         "spread": round(spread, 3), "passes": PASSES,
-        "rollbacks": runners[0].stats()["rollbacks"],
+        "rollbacks": st["rollbacks"],
+        "dispatches": runners[0].device_dispatches - d0,
+        "host_uploads": st["host_uploads"] - u0,
+        "packed": st["packed"],
     }))
 
 
@@ -304,6 +317,80 @@ def bench_coalescing(n_entities=2000, frames=240, chunk=4):
         }))
 
 
+def bench_megastep(n_entities=2000, flushes=30, n=8):
+    """Run-behind/headless cadence: each host update owes `n` frames over a
+    steady predicted p2p pair (constant inputs, no rollbacks).  Measures
+    megastep=False (one fused k=n dispatch + per-flush staging) against
+    megastep=True (the device-resident N-tick program: one dispatch fed by
+    ONE packed upload, snapshot ring resident on device) — the lever that
+    kills the dispatch floor for catch-up (docs/architecture.md
+    "Megastep")."""
+    import numpy as np
+
+    from bevy_ggrs_tpu import (
+        GgrsRunner, PlayerType, SessionBuilder, SessionState,
+    )
+    from bevy_ggrs_tpu.models import stress
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    for megastep in (False, True):
+        net = ChannelNetwork(seed=13)
+        socks = [net.endpoint("a"), net.endpoint("b")]
+        runners = []
+        for i in range(2):
+            app = stress.make_app(n_entities, capacity=n_entities)
+            b = (SessionBuilder.for_app(app).with_input_delay(2)
+                 .with_disconnect_timeout(60.0)
+                 .with_disconnect_notify_delay(30.0)
+                 .add_player(PlayerType.LOCAL, i)
+                 .add_player(PlayerType.REMOTE, 1 - i,
+                             "b" if i == 0 else "a"))
+            runners.append(GgrsRunner(
+                app, b.start_p2p_session(socks[i]),
+                read_inputs=lambda hs: {h: np.uint8(0) for h in hs},
+                coalesce_frames=n, megastep=megastep,
+            ))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            net.deliver()
+            for r in runners:
+                r.update(0.0)
+            if all(r.session.current_state() == SessionState.RUNNING
+                   for r in runners):
+                break
+            time.sleep(0.001)
+        for _ in range(8):  # warmup: compile + settle the flush cadence
+            net.deliver()
+            for r in runners:
+                r.update(n / 60.0)
+
+        def run(m, runners=runners, net=net):
+            for _ in range(m // n):
+                net.deliver()
+                for r in runners:
+                    r.update(n / 60.0)
+
+        r0 = runners[0]
+        d0, u0, f0 = (r0.device_dispatches, r0.stats()["host_uploads"],
+                      r0.frame)
+        med, spread = _timed_passes(run, flushes * n)
+        st = r0.stats()
+        print(json.dumps({
+            "metric": (
+                f"megastep_{'on' if megastep else 'off'}_catchup_"
+                f"frames_per_sec_{n_entities}ent_n{n}"
+            ),
+            "value": round(med, 1), "unit": "frames/s",
+            "spread": round(spread, 3), "passes": PASSES,
+            "dispatches": r0.device_dispatches - d0,
+            "host_uploads": st["host_uploads"] - u0,
+            "frames": r0.frame - f0,
+            "rollbacks": st["rollbacks"],
+        }))
+        for r in runners:
+            r.finish()
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -316,6 +403,8 @@ if __name__ == "__main__":
                     help="run only the batched-lobbies comparison")
     ap.add_argument("--coalesce-only", action="store_true",
                     help="run only the tick-coalescing comparison")
+    ap.add_argument("--megastep-only", action="store_true",
+                    help="run only the megastep on/off comparison")
     args = ap.parse_args()
 
     print(json.dumps({"metric": "platform",
@@ -327,6 +416,8 @@ if __name__ == "__main__":
         bench_batched_lobbies(m=16, n_entities=10_000, ticks=30)
     elif args.coalesce_only:
         bench_coalescing()
+    elif args.megastep_only:
+        bench_megastep()
     else:
         bench_synctest()
         bench_synctest(n_entities=100_000, ticks=100)
@@ -335,3 +426,4 @@ if __name__ == "__main__":
         bench_batched_lobbies(m=16, n_entities=2000)
         bench_batched_lobbies(m=16, n_entities=10_000, ticks=30)
         bench_coalescing()
+        bench_megastep()

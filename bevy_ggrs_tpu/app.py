@@ -24,6 +24,9 @@ from .ops.resim import (
     make_advance_fn,
     make_canonical_branched_fn,
     make_canonical_resim_fn,
+    make_packed_canonical_resim_fn,
+    make_packed_resim_fn,
+    make_packed_speculate_fn,
     make_resim_fn,
     make_speculate_fn,
 )
@@ -172,7 +175,9 @@ class App:
 
     def _invalidate(self):
         for k in ("advance_fn", "resim_fn", "resim_fn_donated",
-                  "speculate_fn", "checksum_fn", "branched_fn"):
+                  "speculate_fn", "checksum_fn", "branched_fn",
+                  "packed_spec", "packed_resim_fn", "packed_resim_fn_donated",
+                  "packed_speculate_fn"):
             self.__dict__.pop(k, None)
 
     @cached_property
@@ -282,6 +287,63 @@ class App:
     @cached_property
     def speculate_fn(self):
         return make_speculate_fn(self.reg, self.step, self.fps, self.seed, self.retention)
+
+    # -- packed single-upload programs (ops/packing.py) ---------------------
+
+    @cached_property
+    def packed_spec(self):
+        """Static packed-buffer layout for this app's input spec."""
+        from .ops.packing import PackedSpec
+
+        return PackedSpec.for_app(self)
+
+    @cached_property
+    def packed_resim_fn(self):
+        """Single-upload resim: ``fn(state, packed int8[k+1, W]) ->
+        (final, stacked, checks)`` — the dispatch-floor fix (inputs, status
+        and start frame ride ONE int8 buffer, split in-program by a pure
+        bitcast; docs/dispatch_floor.md).
+
+        Canonical-depth apps get the fixed-shape packed program, which
+        returns stacked/checks UNTRIMMED at ``canonical_depth`` rows (the
+        driver tracks the real count).  ``None`` under
+        ``canonical_branches``: the branched program keeps its own
+        ``[B, K]`` upload shape and the driver falls back to the unpacked
+        branched path."""
+        if self.canonical_branches is not None:
+            return None
+        if self.canonical_depth is not None:
+            return make_packed_canonical_resim_fn(
+                self.reg, self.step, self.packed_spec, self.fps, self.seed,
+                self.retention, self.canonical_depth,
+            )
+        return make_packed_resim_fn(
+            self.reg, self.step, self.packed_spec, self.fps, self.seed,
+            self.retention,
+        )
+
+    @cached_property
+    def packed_resim_fn_donated(self):
+        """Donating packed resim — same donation contract as
+        :attr:`resim_fn_donated`, and ``None`` in both canonical modes for
+        the same program-variant-drift rationale."""
+        if self.canonical_branches is not None or self.canonical_depth is not None:
+            return None
+        return make_packed_resim_fn(
+            self.reg, self.step, self.packed_spec, self.fps, self.seed,
+            self.retention, donate=True,
+        )
+
+    @cached_property
+    def packed_speculate_fn(self):
+        """Single-upload speculation fan-out (``None`` in canonical modes —
+        the runner refuses a plain speculation cache there anyway)."""
+        if self.canonical_branches is not None or self.canonical_depth is not None:
+            return None
+        return make_packed_speculate_fn(
+            self.reg, self.step, self.packed_spec, self.fps, self.seed,
+            self.retention,
+        )
 
     @cached_property
     def checksum_fn(self):

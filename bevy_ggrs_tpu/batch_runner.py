@@ -179,6 +179,7 @@ class BatchedRunner:
         on_event: Optional[Callable[[int, object], None]] = None,
         k_max: Optional[int] = None,
         pipeline: bool = True,
+        packed: bool = True,
         mesh=None,
     ):
         if app.canonical_depth is not None or app.canonical_branches is not None:
@@ -287,6 +288,18 @@ class BatchedRunner:
         )
         self._stage_status = np.zeros((m_pad, self.k_max, self._np), np.int8)
         self._stage_starts = np.zeros((m_pad,), np.int32)
+        # packed single-upload staging (ops/packing.py): the wave's inputs,
+        # status, per-lobby start frames AND per-lobby n_real ride ONE
+        # persistent int8 buffer — a wave costs one host->device upload
+        # instead of 3-4.  Pad lanes (sharded mode) are zeroed here and
+        # never written, so their prefix reads n_real=0 forever; idle REAL
+        # lanes get their prefix rewritten every wave (a stale nonzero
+        # n_real from a previous wave would resurrect dead advances).
+        self.packed = bool(packed)
+        self._stage_packed = (
+            app.packed_spec.new_batch_buffer(m_pad, self.k_max)
+            if self.packed else None
+        )
         # stable bound-method refs: snapshot-strategy hooks fused into the
         # batched load/save programs (and the jit-cache keys of
         # fused_load_rows / fused_gather_rows)
@@ -525,20 +538,39 @@ class BatchedRunner:
             # bucket tail (padding inputs never affect results — masked by
             # n_real — but keeping them finite avoids garbage-driven traps)
             with ph.phase("stage_inputs"):
-                inputs, status = self._stage_inputs, self._stage_status
-                starts = self._stage_starts
-                starts[:m] = self.frames  # pad lanes (sharded mode) keep 0
-                for b, a in enumerate(adv):
-                    kb = len(a)
-                    if not kb:
-                        continue
-                    bi, bs = inputs[b], status[b]
-                    for i, x in enumerate(a):
-                        bi[i] = x.inputs
-                        bs[i] = x.status
-                    if kb < bucket:
-                        bi[kb:bucket] = bi[kb - 1]
-                        bs[kb:bucket] = bs[kb - 1]
+                if self.packed:
+                    from .ops.packing import (
+                        pack_prefix,
+                        pack_row,
+                        repeat_last_row,
+                    )
+
+                    pspec = self.app.packed_spec
+                    packed = self._stage_packed
+                    for b, a in enumerate(adv):
+                        kb = len(a)
+                        lane = packed[b]
+                        # prefix rewritten EVERY wave: an idle lane must
+                        # read n_real=0 even if a past wave left payload
+                        pack_prefix(lane, self.frames[b], kb)
+                        for i, x in enumerate(a):
+                            pack_row(pspec, lane, i, x.inputs, x.status)
+                        repeat_last_row(lane, kb, bucket)
+                else:
+                    inputs, status = self._stage_inputs, self._stage_status
+                    starts = self._stage_starts
+                    starts[:m] = self.frames  # pad lanes keep 0
+                    for b, a in enumerate(adv):
+                        kb = len(a)
+                        if not kb:
+                            continue
+                        bi, bs = inputs[b], status[b]
+                        for i, x in enumerate(a):
+                            bi[i] = x.inputs
+                            bs[i] = x.status
+                        if kb < bucket:
+                            bi[kb:bucket] = bi[kb - 1]
+                            bs[kb:bucket] = bs[kb - 1]
             self.device_dispatches += 1
             self._m_dispatches.inc()
             self._m_resim_frames.inc(sum(max(k - 1, 0) for k in ks))
@@ -556,9 +588,16 @@ class BatchedRunner:
                 self.planner.plan(ks)
                 wave_ks = ks + [0] * (self._m_pad - m)
             with ph.phase("wave_dispatch"), span("AdvanceWorldBatched"):
-                bucket, finals, stacked, checks_flat = self.exec.run_wave(
-                    self.worlds, inputs, status, starts, wave_ks
-                )
+                if self.packed:
+                    bucket, finals, stacked, checks_flat = (
+                        self.exec.run_wave_packed(
+                            self.worlds, self._stage_packed, wave_ks
+                        )
+                    )
+                else:
+                    bucket, finals, stacked, checks_flat = self.exec.run_wave(
+                        self.worlds, inputs, status, starts, wave_ks
+                    )
                 batch = BatchChecks(checks_flat)
                 if self.pipeline:
                     self._rbq.start(batch)
@@ -641,6 +680,7 @@ class BatchedRunner:
         executor's compile/dispatch/bucket histogram stats."""
         out = {
             "lobbies": len(self.sessions),
+            "packed": self.packed,
             "ticks": self.ticks,
             "rollbacks": self.rollbacks,
             "device_dispatches": self.device_dispatches,

@@ -173,6 +173,85 @@ def make_batched_exact_fn(app, k: int, *, unroll: int = 1,
     return jax.jit(recycling, donate_argnums=(4, 5))
 
 
+def make_batched_packed_padded_fn(app, k: int, *, unroll: int = 1,
+                                  fused_checksums: bool = False):
+    """Packed single-upload variant of :func:`make_batched_padded_fn`:
+    ``fn(batched_world[M], packed int8[M, k + 1, W]) -> (finals[M],
+    stacked[M, k], checks_flat[M * k, 2])``.
+
+    Each lane's prefix row carries its OWN ``(start_frame, n_real)`` (ops/
+    packing.py), so the per-lobby start-frame and mask vectors that used to
+    ride as separate uploads are folded into the one buffer — a wave costs
+    one host->device upload total instead of four.  The unpack is a pure
+    bitcast; arithmetic is unchanged, so lanes stay bit-identical to the
+    unpacked program (tests/test_packed.py)."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    from .packing import unpack_seq
+
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+    pspec = app.packed_spec
+
+    def lane(w, pk):
+        inputs, status, start, n_real, _hl, _ls = unpack_seq(pspec, pk)
+        return resim_padded(
+            reg, step, w, inputs, status, start, n_real, retention, fps,
+            seed, unroll=unroll, fused_checksums=fused_checksums,
+        )
+
+    def body(batched_world, packed_b):
+        finals, stacked, checks = jax.vmap(lane)(batched_world, packed_b)
+        return finals, stacked, checks.reshape(-1, 2)
+
+    return jax.jit(body)
+
+
+def make_batched_packed_exact_fn(app, k: int, *, unroll: int = 1,
+                                 fused_checksums: bool = False,
+                                 donate_outputs: bool = False):
+    """Packed single-upload variant of :func:`make_batched_exact_fn` (the
+    unmasked full-wave program): ``fn(batched_world[M],
+    packed int8[M, k + 1, W]) -> (finals, stacked, checks_flat)``; the
+    per-lane prefix supplies the start frame (``n_real`` is ignored — every
+    lane advances exactly ``k``).  ``donate_outputs=True`` appends the
+    previous call's ``(prev_stacked, prev_checks)`` as donated parameters,
+    same recycling contract as the unpacked builder."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    from .packing import unpack_seq
+
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+    pspec = app.packed_spec
+
+    def lane(w, pk):
+        inputs, status, start, _nr, _hl, _ls = unpack_seq(pspec, pk)
+        return resim(
+            reg, step, w, inputs, status, start, retention, fps, seed,
+            unroll=unroll, fused_checksums=fused_checksums,
+        )
+
+    def core(batched_world, packed_b):
+        finals, stacked, checks = jax.vmap(lane)(batched_world, packed_b)
+        return finals, stacked, checks.reshape(-1, 2)
+
+    if not donate_outputs:
+        return jax.jit(core)
+
+    def recycling(batched_world, packed_b, prev_stacked, prev_checks):
+        del prev_stacked, prev_checks  # donated for output aliasing only
+        return core(batched_world, packed_b)
+
+    return jax.jit(recycling, donate_argnums=(2, 3))
+
+
 def bucket_sizes(k_max: int) -> Tuple[int, ...]:
     """Power-of-two depth buckets up to (and always including) ``k_max``:
     ``bucket_sizes(12) == (1, 2, 4, 8, 12)``.  A wave whose hottest lobby
@@ -262,6 +341,28 @@ class BucketedWaveExecutor:
             "batched_program_compiles_total",
             "bucketed wave programs built (kind x bucket)",
         )
+        # upload census (same family the solo runner binds): run_wave_packed
+        # issues ONE upload per wave; the unpacked run_wave issues 3 (4 for
+        # ragged waves, which add the n_real vector)
+        self.host_uploads = 0
+        self.packed_upload_bytes = 0
+        self._m_uploads = _reg.bind_histogram(
+            "uploads_per_dispatch",
+            "host->device uploads issued per fused dispatch (1 on the "
+            "packed path)",
+            buckets=(1, 2, 3, 4, 8),
+        )
+        self._m_packed_bytes = _reg.bind_counter(
+            "packed_upload_bytes",
+            "bytes staged through packed single-upload buffers",
+        )
+
+    def _note_uploads(self, n: int, packed_buf=None) -> None:
+        self.host_uploads += n
+        self._m_uploads.observe(n)
+        if packed_buf is not None:
+            self.packed_upload_bytes += packed_buf.nbytes
+            self._m_packed_bytes.inc(packed_buf.nbytes)
 
     def bucket_for(self, k_hot: int) -> int:
         """Smallest bucket >= ``k_hot`` (raises beyond ``k_max``)."""
@@ -286,6 +387,21 @@ class BucketedWaveExecutor:
                 fn = make_batched_exact_fn(
                     self.app, bucket, unroll=self.unroll,
                     fused_checksums=self.fused_checksums, donate_outputs=True,
+                )
+            elif kind == "packed_exact":
+                fn = make_batched_packed_exact_fn(
+                    self.app, bucket, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums,
+                )
+            elif kind == "packed_exact_recycle":
+                fn = make_batched_packed_exact_fn(
+                    self.app, bucket, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums, donate_outputs=True,
+                )
+            elif kind == "packed_padded":
+                fn = make_batched_packed_padded_fn(
+                    self.app, bucket, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums,
                 )
             else:
                 fn = make_batched_padded_fn(
@@ -342,12 +458,19 @@ class BucketedWaveExecutor:
             raise ValueError("run_wave needs at least one advancing lobby")
         bucket = self.bucket_for(k_hot)
         exact = all(k == bucket for k in ks)
-        inp = inputs[:, :bucket]
-        st = status[:, :bucket]
+        # persistent staging buffers are rewritten next wave: commit the
+        # sliced uploads synchronously so the asynchronous transfer can
+        # never read a later wave's bytes (utils/staging.py)
+        from ..utils.staging import commit
+
+        inp = commit(inputs[:, :bucket])
+        st = commit(status[:, :bucket])
+        starts = commit(np.asarray(starts, np.int32))
         self.dispatch_count += 1
         self.bucket_hist[bucket] += 1
         self._m_dispatches.inc()
         if exact:
+            self._note_uploads(3)
             if self.recycle_outputs:
                 key = ("exact_recycle", bucket)
                 prev = self._prev_out.pop(key, None)
@@ -366,9 +489,53 @@ class BucketedWaveExecutor:
                     "exact", bucket, worlds, inp, st, starts
                 )
         else:
+            self._note_uploads(4)
             n_real = np.asarray(ks, np.int32)
             finals, stacked, checks = self._dispatch(
                 "padded", bucket, worlds, inp, st, starts, n_real
+            )
+        return bucket, finals, stacked, checks
+
+    def run_wave_packed(self, worlds, packed, ks):
+        """Dispatch one wave fed by the packed single-upload staging buffer
+        ``packed int8[M, >= bucket + 1, W]`` (per-lane prefix row carries
+        that lobby's start frame and ``n_real`` — ops/packing.py); same
+        return contract as :meth:`run_wave`.  The whole wave costs ONE
+        host->device upload (the resident stacked world never leaves the
+        device)."""
+        ks = list(ks)
+        k_hot = max(ks)
+        if k_hot <= 0:
+            raise ValueError("run_wave needs at least one advancing lobby")
+        bucket = self.bucket_for(k_hot)
+        exact = all(k == bucket for k in ks)
+        from ..utils.staging import commit
+
+        pk = commit(packed[:, :bucket + 1])
+        self.dispatch_count += 1
+        self.bucket_hist[bucket] += 1
+        self._m_dispatches.inc()
+        self._note_uploads(1, pk)
+        if exact:
+            if self.recycle_outputs:
+                key = ("packed_exact_recycle", bucket)
+                prev = self._prev_out.pop(key, None)
+                if prev is None:
+                    finals, stacked, checks = self._dispatch(
+                        "packed_exact", bucket, worlds, pk
+                    )
+                else:
+                    finals, stacked, checks = self._dispatch(
+                        *key, worlds, pk, *prev
+                    )
+                self._prev_out[key] = (stacked, checks)
+            else:
+                finals, stacked, checks = self._dispatch(
+                    "packed_exact", bucket, worlds, pk
+                )
+        else:
+            finals, stacked, checks = self._dispatch(
+                "packed_padded", bucket, worlds, pk
             )
         return bucket, finals, stacked, checks
 
@@ -387,6 +554,8 @@ class BucketedWaveExecutor:
             "bucket_hist": {k: v for k, v in self.bucket_hist.items() if v},
             "jit_entries": jit_entries,
             "compile_ms": dict(self.compile_ms),
+            "host_uploads": self.host_uploads,
+            "packed_upload_bytes": self.packed_upload_bytes,
         }
 
 
@@ -489,6 +658,96 @@ def make_sharded_exact_fn(app, k: int, mesh, *, unroll: int = 1,
     return jax.jit(body)
 
 
+def make_sharded_packed_padded_fn(app, k: int, mesh, *, unroll: int = 1,
+                                  fused_checksums: bool = False):
+    """Packed single-upload variant of :func:`make_sharded_padded_fn`:
+    ``fn(batched_world[M], packed int8[M, k + 1, W])`` with both arguments
+    sharded over the ``"lobby"`` mesh axis.  Each device unpacks its own
+    block of lanes (prefix bitcast is per-lane, no collectives)."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import LOBBY_AXIS
+    from .packing import unpack_seq
+
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+    pspec = app.packed_spec
+    spec = P(LOBBY_AXIS)
+
+    def lane(w, pk):
+        inputs, status, start, n_real, _hl, _ls = unpack_seq(pspec, pk)
+        return resim_padded(
+            reg, step, w, inputs, status, start, n_real, retention, fps,
+            seed, unroll=unroll, fused_checksums=fused_checksums,
+        )
+
+    def local(batched_world, packed_b):
+        return jax.vmap(lane)(batched_world, packed_b)
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(spec, spec, spec),
+        check_rep=False,  # no replication to track: lanes are independent
+    )
+
+    def body(batched_world, packed_b):
+        finals, stacked, checks = sharded(batched_world, packed_b)
+        return finals, stacked, checks.reshape(-1, 2)
+
+    return jax.jit(body)
+
+
+def make_sharded_packed_exact_fn(app, k: int, mesh, *, unroll: int = 1,
+                                 fused_checksums: bool = False):
+    """Packed single-upload variant of :func:`make_sharded_exact_fn` (no
+    recycling variant, same rationale as the unpacked sharded builder)."""
+    if app.canonical_depth is not None or app.canonical_branches is not None:
+        raise ValueError(
+            "many-worlds batching is incompatible with canonical mode "
+            "(see make_batched_resim_fn)"
+        )
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import LOBBY_AXIS
+    from .packing import unpack_seq
+
+    reg, step, fps = app.reg, app.step, app.fps
+    seed, retention = app.seed, app.retention
+    pspec = app.packed_spec
+    spec = P(LOBBY_AXIS)
+
+    def lane(w, pk):
+        inputs, status, start, _nr, _hl, _ls = unpack_seq(pspec, pk)
+        return resim(
+            reg, step, w, inputs, status, start, retention, fps, seed,
+            unroll=unroll, fused_checksums=fused_checksums,
+        )
+
+    def local(batched_world, packed_b):
+        return jax.vmap(lane)(batched_world, packed_b)
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(spec, spec, spec),
+        check_rep=False,
+    )
+
+    def body(batched_world, packed_b):
+        finals, stacked, checks = sharded(batched_world, packed_b)
+        return finals, stacked, checks.reshape(-1, 2)
+
+    return jax.jit(body)
+
+
 class ShardedWaveExecutor(BucketedWaveExecutor):
     """:class:`BucketedWaveExecutor` whose wave programs shard the lobby
     axis over a device mesh — the many-lobbies-across-the-mesh executor
@@ -544,6 +803,21 @@ class ShardedWaveExecutor(BucketedWaveExecutor):
             "lobby-sharded wave programs built (kind x bucket)",
         )
         self._trim_fns: Dict[Tuple[int, int, int], object] = {}
+        # staging commits land lobby-axis-sharded so the shard_map programs
+        # read device-local rows with no reshard (utils/staging.py)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+
+        from ..parallel.mesh import LOBBY_AXIS
+
+        self._stage_sharding = NamedSharding(mesh, _P(LOBBY_AXIS))
+
+    def _commit_sharded(self, arr):
+        """Synchronous lobby-sharded upload of a (reused) staging buffer —
+        same rewrite-race rationale as the parent's plain commits."""
+        from ..utils.staging import commit
+
+        return commit(arr, self._stage_sharding)
 
     def pad_lobbies(self, m: int) -> int:
         """Smallest multiple of the device count >= ``m``."""
@@ -563,7 +837,17 @@ class ShardedWaveExecutor(BucketedWaveExecutor):
                     self.app, bucket, self.mesh, unroll=self.unroll,
                     fused_checksums=self.fused_checksums,
                 )
-            else:  # pragma: no cover - parent never asks for exact_recycle
+            elif kind == "packed_exact":
+                fn = make_sharded_packed_exact_fn(
+                    self.app, bucket, self.mesh, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums,
+                )
+            elif kind == "packed_padded":
+                fn = make_sharded_packed_padded_fn(
+                    self.app, bucket, self.mesh, unroll=self.unroll,
+                    fused_checksums=self.fused_checksums,
+                )
+            else:  # pragma: no cover - parent never asks for *_recycle here
                 raise ValueError(f"sharded executor has no {kind!r} programs")
             self._fns[(kind, bucket)] = fn
             self.compile_count += 1
@@ -599,20 +883,66 @@ class ShardedWaveExecutor(BucketedWaveExecutor):
             raise ValueError("run_wave needs at least one advancing lobby")
         bucket = self.bucket_for(k_hot)
         exact = all(k == bucket for k in ks)
-        inp = inputs[:, :bucket]
-        st = status[:, :bucket]
+        inp = self._commit_sharded(np.ascontiguousarray(inputs[:, :bucket]))
+        st = self._commit_sharded(np.ascontiguousarray(status[:, :bucket]))
+        starts = self._commit_sharded(np.asarray(starts, np.int32))
         self.dispatch_count += 1
         self.bucket_hist[bucket] += 1
         self._m_dispatches.inc()
         self._m_sharded_dispatches.inc()
         if exact:
+            self._note_uploads(3)
             finals, stacked, checks = self._dispatch(
                 "exact", bucket, worlds, inp, st, starts
             )
         else:
+            self._note_uploads(4)
             n_real = np.asarray(ks, np.int32)
             finals, stacked, checks = self._dispatch(
                 "padded", bucket, worlds, inp, st, starts, n_real
+            )
+        if pad:
+            finals, stacked, checks = self._trim_wave(
+                finals, stacked, checks, m, m_pad, bucket
+            )
+        return bucket, finals, stacked, checks
+
+    def run_wave_packed(self, worlds, packed, ks):
+        """Packed single-upload sharded wave (same contract as the parent's
+        :meth:`run_wave_packed`).  Padded lobby lanes get a zeroed prefix
+        (``n_real = 0``) so the masked program passes them through — the
+        pad block is built host-side, so the wave still costs ONE upload."""
+        ks = list(ks)
+        m = len(ks)
+        m_pad = self.pad_lobbies(m)
+        pad = m_pad - m
+        if pad:
+            from .packing import pack_prefix
+
+            worlds = _pad_rows(worlds, pad)
+            pad_block = np.repeat(packed[-1:], pad, axis=0)
+            for r in range(pad):
+                pack_prefix(pad_block[r], 0, 0)
+            packed = np.concatenate([packed, pad_block])
+            ks = ks + [0] * pad
+        k_hot = max(ks)
+        if k_hot <= 0:
+            raise ValueError("run_wave needs at least one advancing lobby")
+        bucket = self.bucket_for(k_hot)
+        exact = all(k == bucket for k in ks)
+        pk = self._commit_sharded(np.ascontiguousarray(packed[:, :bucket + 1]))
+        self.dispatch_count += 1
+        self.bucket_hist[bucket] += 1
+        self._m_dispatches.inc()
+        self._m_sharded_dispatches.inc()
+        self._note_uploads(1, pk)
+        if exact:
+            finals, stacked, checks = self._dispatch(
+                "packed_exact", bucket, worlds, pk
+            )
+        else:
+            finals, stacked, checks = self._dispatch(
+                "packed_padded", bucket, worlds, pk
             )
         if pad:
             finals, stacked, checks = self._trim_wave(

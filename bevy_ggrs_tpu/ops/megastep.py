@@ -1,0 +1,119 @@
+"""Device-resident N-tick megastep — one dispatch + one upload per flush.
+
+The coalescing path (runner.py ``coalesce_frames``) already fuses N owed
+frames into one ``lax.scan`` dispatch; what still rode the link every flush
+was the rollback path: a LoadRequest materialized a ring snapshot host-side
+(one gather dispatch) before the advance dispatch could run.  The megastep
+program moves the snapshot ring ONTO the device and folds the load into the
+same dispatch, the way Octax / the Podracer "Anakin" pattern keep the whole
+env loop on device (PAPERS.md):
+
+- the device ring is a ``[R, ...]`` stacked pytree of the last R advanced
+  states plus an int32 ``ring_frames[R]`` tag vector, threaded through every
+  dispatch (donated, so XLA updates it in place — no per-tick ring copy);
+- the packed prefix (ops/packing.py) carries ``has_load``/``load_slot``:
+  the program selects branchlessly between the live state and ring row
+  ``load_slot`` per leaf (``jnp.where`` on a scalar — no host branch, no
+  program variant per shape);
+- after the masked fixed-``k_max`` resim, the real rows scatter back into
+  the ring at ``(start_frame + 1 + i) % R`` — padded rows get slot index
+  ``R`` and drop (``.at[...].set(mode="drop")``), so the scatter is
+  branchless too.
+
+The HOST keeps a slot->frame mirror: a rollback whose target frame is still
+resident in the device ring fuses (1 upload + 1 dispatch services the load
+AND the N replayed frames); a target that has already been overwritten —
+or predates the ring — falls back to the host ring's materialize path,
+which is bit-identical by construction (the device ring row IS the same
+stacked row the host ring's LazySlice points at).
+
+Bit-determinism note: the megastep is ONE fixed-shape program (fixed
+``k_max``, fixed ring depth), so every flush runs the same machine code —
+the same property canonical mode buys — and its checksums are pinned
+bit-equal to the per-tick driver by tests/test_megastep.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..snapshot.world import Registry
+from ..utils.frames import NULL_FRAME
+from .resim import StepFn, resim_padded
+
+
+def init_device_ring(world, slots: int):
+    """Allocate the device-resident ring for ``world``'s structure: a
+    ``[slots, ...]`` zeroed stacked pytree plus a ``ring_frames`` tag vector
+    of ``NULL_FRAME`` (one jitted dispatch).  Unwritten rows are never
+    selected — the host mirror only fuses loads for frames it has seen the
+    program write."""
+
+    def body(w):
+        ring = jax.tree.map(
+            lambda a: jnp.zeros((slots, *a.shape), a.dtype), w
+        )
+        frames = jnp.full((slots,), NULL_FRAME, jnp.int32)
+        return ring, frames
+
+    return jax.jit(body)(world)
+
+
+def make_megastep_fn(reg: Registry, step_fn: StepFn, spec, fps: int,
+                     seed: int = 0, retention: int = 16, k_max: int = 8,
+                     ring_slots: int = 16, *, unroll: int = 1,
+                     fused_checksums: bool = False):
+    """Build the megastep program.
+
+    ``fn(state, ring, ring_frames, packed int8[k_max+1, W]) ->
+    (final, ring', ring_frames', stacked, checks)`` where ``packed`` is the
+    ONE upload of the flush (prefix ``[start_frame, n_real, has_load,
+    load_slot]`` + payload rows, ops/packing.py).  ``ring``/``ring_frames``
+    are donated: the caller's handles are dead after the call and XLA
+    updates the ring in place instead of copying R world snapshots per
+    dispatch.  ``stacked``/``checks`` come back untrimmed at ``k_max`` rows
+    (rows ``>= n_real`` carry the held state, exactly like the canonical
+    padded program) so saves slice real rows without a trim dispatch."""
+    from .packing import unpack_seq
+
+    def body(state, ring, ring_frames, packed):
+        inputs_seq, status_seq, start_frame, n_real, has_load, load_slot = (
+            unpack_seq(spec, packed)
+        )
+        # branchless rollback: per leaf, pick ring row `load_slot` when the
+        # prefix says so, else carry the live state (scalar-cond select —
+        # both sides are resident, no host sync, one program either way)
+        slot = jnp.clip(load_slot, 0, ring_slots - 1)
+        loaded = jax.tree.map(
+            lambda r: jax.lax.dynamic_index_in_dim(
+                r, slot, axis=0, keepdims=False
+            ),
+            ring,
+        )
+        take_load = has_load != 0
+        state = jax.tree.map(
+            lambda a, b: jnp.where(take_load, a, b), loaded, state
+        )
+        final, stacked, checks = resim_padded(
+            reg, step_fn, state, inputs_seq, status_seq, start_frame, n_real,
+            retention, fps, seed, unroll=unroll,
+            fused_checksums=fused_checksums,
+        )
+        # branchless ring writeback: real row i lands at frame % R; padded
+        # rows get the out-of-range slot R and drop.  jnp's % follows the
+        # divisor's sign, so wrapped (negative) int32 frames still map to
+        # [0, R) — matching the host mirror's python `% R`.
+        idx = jnp.arange(k_max, dtype=jnp.int32)
+        new_frames = start_frame + jnp.int32(1) + idx
+        slots = jnp.where(
+            idx < n_real, new_frames % jnp.int32(ring_slots),
+            jnp.int32(ring_slots),
+        )
+        ring = jax.tree.map(
+            lambda r, s: r.at[slots].set(s, mode="drop"), ring, stacked
+        )
+        ring_frames = ring_frames.at[slots].set(new_frames, mode="drop")
+        return final, ring, ring_frames, stacked, checks
+
+    return jax.jit(body, donate_argnums=(1, 2))

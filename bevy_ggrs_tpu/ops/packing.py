@@ -1,0 +1,150 @@
+"""Packed single-upload staging — one int8 buffer per dispatch.
+
+The dispatch-floor census (docs/dispatch_floor.md) showed the steady-state
+P2P tick pays THREE host->device uploads per fused dispatch — ``inputs
+[k, P, ...]``, ``status int8[k, P]`` and the start-frame scalar — and on a
+remote-attached TPU each upload costs flat link latency, so the upload
+count, not the byte count, is the tax.  This module fuses all three (plus
+the megastep's load-selection words) into ONE ``int8[k + 1, W]`` buffer:
+
+- **row 0 is the prefix**: four little-endian int32 words
+  ``[start_frame, n_real, has_load, load_slot]`` occupying the first 16
+  bytes (``has_load``/``load_slot`` are only read by the megastep program;
+  plain packed dispatches carry zeros).
+- **rows 1..k are per-frame payloads**: the frame's input bytes
+  (``P * prod(input_shape) * itemsize``, raw little-endian) followed by
+  the ``P`` int8 status bytes.
+
+The host packs with numpy ``.view`` reinterpretation into a persistent
+buffer (no per-tick allocation); the jitted program splits the buffer back
+with ``jax.lax.bitcast_convert_type`` — a pure bit reinterpretation, so
+the scan body receives exactly the arrays the three-upload path fed it and
+the arithmetic is unchanged.  Both the x86 host and XLA's CPU/TPU backends
+are little-endian, which is the one representation assumption the format
+makes (asserted at import below).
+
+Width is padded to ``max(payload, 16)`` rounded up to a multiple of 4 so
+the prefix bitcast stays aligned and the row stride is word-aligned.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import prod
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# prefix layout: int32 words [start_frame, n_real, has_load, load_slot]
+PREFIX_WORDS = 4
+PREFIX_BYTES = PREFIX_WORDS * 4
+
+# the .view/bitcast round trip is only an identity on little-endian hosts;
+# every supported platform (x86/arm hosts, XLA CPU/TPU backends) is LE
+import sys as _sys
+
+assert _sys.byteorder == "little", "packed staging assumes a little-endian host"
+
+
+@dataclass(frozen=True)
+class PackedSpec:
+    """Static layout of one app's packed buffer (derived from the input
+    spec; hashable so jit-side helpers can key caches on it)."""
+
+    players: int
+    input_shape: Tuple[int, ...]
+    input_dtype: np.dtype
+    elems: int  # per-player input elements
+    in_bytes: int  # all players' input bytes per frame row
+    st_bytes: int  # status bytes per frame row (== players)
+    payload: int  # in_bytes + st_bytes
+    width: int  # row stride (>= payload and >= PREFIX_BYTES, 4-aligned)
+
+    @classmethod
+    def from_parts(cls, players: int, input_shape, input_dtype) -> "PackedSpec":
+        input_shape = tuple(input_shape)
+        input_dtype = np.dtype(input_dtype)
+        elems = prod(input_shape) if input_shape else 1
+        in_bytes = players * elems * input_dtype.itemsize
+        st_bytes = players
+        payload = in_bytes + st_bytes
+        width = max(payload, PREFIX_BYTES)
+        width = -(-width // 4) * 4
+        return cls(
+            players=players, input_shape=input_shape, input_dtype=input_dtype,
+            elems=elems, in_bytes=in_bytes, st_bytes=st_bytes,
+            payload=payload, width=width,
+        )
+
+    @classmethod
+    def for_app(cls, app) -> "PackedSpec":
+        return cls.from_parts(app.num_players, app.input_shape, app.input_dtype)
+
+    def new_buffer(self, k: int) -> np.ndarray:
+        """Fresh zeroed host buffer for a ``k``-frame dispatch (+prefix)."""
+        return np.zeros((k + 1, self.width), np.int8)
+
+    def new_batch_buffer(self, m: int, k: int) -> np.ndarray:
+        """Per-lobby batch of packed buffers: ``int8[m, k + 1, W]``."""
+        return np.zeros((m, k + 1, self.width), np.int8)
+
+
+# -- host-side packing (numpy, in place) -------------------------------------
+
+def pack_prefix(buf: np.ndarray, start_frame: int, n_real: int,
+                has_load: int = 0, load_slot: int = 0) -> None:
+    """Write the int32 prefix words into row 0 of ``buf`` (``int8[k+1, W]``
+    or a single lane of a batch buffer)."""
+    pf = buf[0, :PREFIX_BYTES].view(np.int32)
+    pf[0] = start_frame
+    pf[1] = n_real
+    pf[2] = has_load
+    pf[3] = load_slot
+
+
+def pack_row(spec: PackedSpec, buf: np.ndarray, i: int, inputs, status) -> None:
+    """Write frame ``i``'s input+status bytes into row ``1 + i``."""
+    row = buf[1 + i]
+    row[:spec.in_bytes] = (
+        np.asarray(inputs, spec.input_dtype).reshape(-1).view(np.int8)
+    )
+    row[spec.in_bytes:spec.payload] = np.asarray(status, np.int8).reshape(-1)
+
+
+def repeat_last_row(buf: np.ndarray, k: int, k_pad: int) -> None:
+    """Repeat payload row ``k`` through rows ``k+1..k_pad`` (fixed-shape
+    programs mask padded rows by ``n_real``; repeating the last real row
+    keeps the masked arithmetic finite, matching ``pad_repeat_last``)."""
+    if k_pad > k and k > 0:
+        buf[1 + k:1 + k_pad] = buf[k]
+
+
+# -- device-side unpacking (traced; pure bit reinterpretation) ---------------
+
+def unpack_seq(spec: PackedSpec, packed):
+    """Split one packed buffer back into the three-upload arrays inside a
+    jitted program.
+
+    ``packed`` is ``int8[k + 1, W]`` (k static from the shape).  Returns
+    ``(inputs[k, P, *shape], status int8[k, P], start_frame, n_real,
+    has_load, load_slot)`` — the last four as traced int32 scalars.
+    ``bitcast_convert_type`` reinterprets bits without arithmetic, so the
+    outputs are bit-identical to what the unpacked path uploaded."""
+    k = packed.shape[0] - 1
+    prefix = jax.lax.bitcast_convert_type(
+        packed[0, :PREFIX_BYTES].reshape(PREFIX_WORDS, 4), jnp.int32
+    )
+    rows = packed[1:]
+    raw = rows[:, :spec.in_bytes]
+    if spec.input_dtype.itemsize == 1:
+        inputs = jax.lax.bitcast_convert_type(raw, spec.input_dtype)
+    else:
+        inputs = jax.lax.bitcast_convert_type(
+            raw.reshape(k, spec.players * spec.elems, spec.input_dtype.itemsize),
+            spec.input_dtype,
+        )
+    inputs = inputs.reshape(k, spec.players, *spec.input_shape)
+    status = rows[:, spec.in_bytes:spec.payload].reshape(k, spec.players)
+    return inputs, status, prefix[0], prefix[1], prefix[2], prefix[3]

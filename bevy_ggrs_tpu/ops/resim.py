@@ -362,6 +362,84 @@ def make_speculate_fn(reg: Registry, step_fn: StepFn, fps: int, seed: int = 0,
     return fn
 
 
+def make_packed_resim_fn(reg: Registry, step_fn: StepFn, spec, fps: int,
+                         seed: int = 0, retention: int = 16,
+                         donate: bool = False):
+    """jit k-frame resim fed by ONE packed upload (ops/packing.py).
+
+    ``fn(state, packed int8[k+1, W]) -> (final, stacked, checks)`` — the
+    single-buffer replacement for :func:`make_resim_fn`'s three uploads
+    (inputs, status, start frame).  The in-program split is a pure bitcast,
+    so the scan body receives bit-identical arrays and the results match
+    the unpacked program's values; one compile per distinct k, as before.
+
+    ``donate=True`` donates the input state (same contract as
+    :func:`make_resim_fn`)."""
+    from .packing import unpack_seq
+
+    def body(state, packed):
+        inputs_seq, status_seq, start_frame, _n, _hl, _ls = unpack_seq(
+            spec, packed
+        )
+        return resim(
+            reg, step_fn, state, inputs_seq, status_seq, start_frame,
+            retention, fps, seed,
+        )
+
+    return jax.jit(body, donate_argnums=(0,) if donate else ())
+
+
+def make_packed_canonical_resim_fn(reg: Registry, step_fn: StepFn, spec,
+                                   fps: int, seed: int = 0,
+                                   retention: int = 16, k_max: int = 16):
+    """Packed single-upload variant of :func:`make_canonical_resim_fn`.
+
+    ``fn(state, packed int8[k_max+1, W]) -> (final, stacked, checks)`` with
+    the real advance count carried in the prefix's ``n_real`` word.  Unlike
+    the unpacked wrapper this returns the stacked/checks outputs UNTRIMMED
+    at ``k_max`` rows — the caller knows the real row count and indexing
+    rows ``< n_real`` is bit-identical to the trimmed view, so skipping the
+    trim saves the per-dispatch trim submission.  No donating variant, for
+    the same program-variant-drift reason :attr:`App.resim_fn_donated` is
+    None in canonical mode."""
+    from .packing import unpack_seq
+
+    @jax.jit
+    def fn(state, packed):
+        inputs_seq, status_seq, start_frame, n_real, _hl, _ls = unpack_seq(
+            spec, packed
+        )
+        return resim_padded(
+            reg, step_fn, state, inputs_seq, status_seq, start_frame, n_real,
+            retention, fps, seed,
+        )
+
+    return fn
+
+
+def make_packed_speculate_fn(reg: Registry, step_fn: StepFn, spec, fps: int,
+                             seed: int = 0, retention: int = 16):
+    """Packed single-upload variant of :func:`make_speculate_fn`: the M
+    candidate branches ride ONE ``int8[M, depth+1, W]`` buffer (per-branch
+    prefix row) instead of three per-dispatch uploads."""
+    from .packing import unpack_seq
+
+    @jax.jit
+    def fn(state, packed_b):
+        def lane(pk):
+            inputs_seq, status_seq, start_frame, _n, _hl, _ls = unpack_seq(
+                spec, pk
+            )
+            return resim(
+                reg, step_fn, state, inputs_seq, status_seq, start_frame,
+                retention, fps, seed,
+            )
+
+        return jax.vmap(lane)(packed_b)
+
+    return fn
+
+
 def select_branch(tree, idx):
     """Pick branch ``idx`` from a leading-axis-M speculation output."""
     return jax.tree.map(lambda a: a[idx], tree)

@@ -65,6 +65,25 @@ class SpeculationCache:
         self.misses = 0
         self.branches_evaluated = 0
         self.bytes_evicted = 0  # device bytes dropped by the BYTE budget only
+        # Packed single-upload staging for the speculate dispatch (same
+        # scheme as the runner's resim path — ops/packing.py): persistent
+        # [M, depth+1, W] int8 buffer, grown geometrically if M changes.
+        self._packed_buf: Optional[np.ndarray] = None
+        self.host_uploads = 0
+        self.packed_upload_bytes = 0
+        from .. import telemetry
+
+        _treg = telemetry.registry()
+        self._m_uploads = _treg.bind_histogram(
+            "uploads_per_dispatch",
+            "host->device uploads issued per fused dispatch (1 on the "
+            "packed path)",
+            buckets=(1, 2, 3, 4, 8),
+        )
+        self._m_packed_bytes = _treg.bind_counter(
+            "packed_upload_bytes",
+            "bytes staged through packed single-upload buffers",
+        )
 
     @property
     def cached_bytes(self) -> int:
@@ -78,6 +97,29 @@ class SpeculationCache:
             tree_device_bytes(branch) for branch in entry.values()
         )
 
+    def _stage_packed(self, cands: np.ndarray, start_frame: int,
+                      depth: int) -> np.ndarray:
+        """Stage the M candidate branches into the persistent packed buffer
+        (one row per frame: the candidate held constant, statuses zero —
+        the exact bytes the unpacked path uploads as three arrays)."""
+        from .packing import pack_prefix, pack_row, repeat_last_row
+
+        spec = self.app.packed_spec
+        m = cands.shape[0]
+        buf = self._packed_buf
+        if buf is None or buf.shape[0] < m or buf.shape[1] != depth + 1:
+            buf = self._packed_buf = spec.new_batch_buffer(m, depth)
+        pk = buf[:m]
+        zero_status = np.zeros(self.app.num_players, np.int8)
+        for b in range(m):
+            pack_prefix(pk[b], start_frame, depth)
+            pack_row(spec, pk[b], 0, cands[b], zero_status)
+            repeat_last_row(pk[b], 1, depth)
+        # reused buffer + async upload: commit synchronously (utils/staging)
+        from ..utils.staging import commit
+
+        return commit(pk)
+
     def speculate(self, world, start_frame: int, used_inputs: np.ndarray) -> None:
         """Fan out candidate branches from ``world`` (the pre-advance state):
         each candidate input row held constant for ``config.depth`` frames."""
@@ -88,12 +130,22 @@ class SpeculationCache:
         if m == 0:
             return
         depth = max(self.config.depth, 1)
-        # [M, depth, P, *shape]: candidate row repeated along the frame axis
-        branches = np.repeat(cands[:, None], depth, axis=1)
-        statuses = np.zeros((m, depth, self.app.num_players), np.int8)
-        finals, stacked, checks = self.app.speculate_fn(
-            world, branches, statuses, start_frame
-        )
+        if self.app.packed_speculate_fn is not None:
+            pk = self._stage_packed(cands, start_frame, depth)
+            finals, stacked, checks = self.app.packed_speculate_fn(world, pk)
+            self.host_uploads += 1
+            self._m_uploads.observe(1)
+            self.packed_upload_bytes += pk.nbytes
+            self._m_packed_bytes.inc(pk.nbytes)
+        else:
+            # [M, depth, P, *shape]: candidate row repeated on the frame axis
+            branches = np.repeat(cands[:, None], depth, axis=1)
+            statuses = np.zeros((m, depth, self.app.num_players), np.int8)
+            finals, stacked, checks = self.app.speculate_fn(
+                world, branches, statuses, start_frame
+            )
+            self.host_uploads += 3
+            self._m_uploads.observe(3)
         self.branches_evaluated += m * depth
         entry = {}
         for b in range(m):

@@ -62,6 +62,8 @@ class GgrsRunner:
         on_confirmed: Optional[Callable[[int], None]] = None,
         coalesce_frames: int = 1,
         pipeline: bool = True,
+        packed: bool = True,
+        megastep: bool = False,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
@@ -165,6 +167,68 @@ class GgrsRunner:
         self._stage_inputs: Optional[np.ndarray] = None
         self._stage_status: Optional[np.ndarray] = None
         self._stage_cap = 0
+        # Packed single-upload staging (ops/packing.py): the three per-
+        # dispatch uploads (inputs, status, frame scalar) fuse into ONE
+        # persistent int8 buffer split in-program by a pure bitcast —
+        # killing 2/3 of the per-tick link-latency share the dispatch-floor
+        # census attributed to uploads (docs/dispatch_floor.md).  Falls
+        # back to the unpacked path automatically when the app has no
+        # packed program (canonical_branches mode).
+        self.packed = bool(packed) and app.packed_resim_fn is not None
+        self._stage_packed: Optional[np.ndarray] = None
+        self._packed_cap = 0
+        # Upload census (always-on plain ints, like device_dispatches):
+        # host->device array uploads issued by fused dispatches, and total
+        # bytes staged through packed buffers — the numbers the bench.py
+        # "uploads" stage gates on
+        self.host_uploads = 0
+        self.packed_upload_bytes = 0
+        _treg = telemetry.registry()
+        self._m_uploads = _treg.bind_histogram(
+            "uploads_per_dispatch",
+            "host->device uploads issued per fused dispatch (1 on the "
+            "packed path)",
+            buckets=(1, 2, 3, 4, 8),
+        )
+        self._m_packed_bytes = _treg.bind_counter(
+            "packed_upload_bytes",
+            "bytes staged through packed single-upload buffers",
+        )
+        # Device-resident megastep (ops/megastep.py): opt-in mode where a
+        # whole coalesced flush — including the rollback load, when its
+        # target is still resident in the on-device snapshot ring — runs as
+        # ONE dispatch fed by ONE packed upload.  The host keeps a
+        # slot->frame mirror of the device ring; misses fall back to the
+        # host ring's materialize path (bit-identical by construction).
+        self.megastep = bool(megastep)
+        if self.megastep:
+            if not app.reg.is_identity_strategy():
+                raise ValueError(
+                    "megastep requires an identity snapshot strategy: the "
+                    "device ring stores live stacked states, and a lossy "
+                    "strategy's store/load round-trip would need to run "
+                    "inside the ring select"
+                )
+            if speculation is not None:
+                raise ValueError(
+                    "megastep and speculation are mutually exclusive (the "
+                    "megastep flush has no per-frame lookup seam)"
+                )
+            if app.canonical_branches is not None:
+                raise ValueError(
+                    "megastep is incompatible with canonical_branches "
+                    "(the branched program owns its own dispatch shape)"
+                )
+            # the ring aliases every recent state, so donation is never safe
+            self.enable_donation = False
+        self.megastep_dispatches = 0
+        self.fused_ring_loads = 0  # rollbacks served from the device ring
+        self._ms_fn = None
+        self._ms_ring = None
+        self._ms_ring_frames = None
+        self._ms_k = 0  # megastep program depth (k_max)
+        self._ms_slots = 0  # device ring depth R
+        self._dev_frames: Dict[int, int] = {}  # slot -> resident frame
         # stacked-save device bytes depend only on the dispatch depth k
         # (shapes are static per app), so compute once per depth instead of
         # walking the pytree every tick
@@ -220,6 +284,12 @@ class GgrsRunner:
         self.ring.clear()
         self._last_stacked = None
         self._last_stacked_frame = None
+        # megastep device-ring state is sized from the session's windows;
+        # a new session rebuilds it lazily at the first flush
+        self._ms_fn = None
+        self._ms_ring = None
+        self._ms_ring_frames = None
+        self._dev_frames = {}
         if session is not None:
             # despawn-retirement safety invariant (ops/resim.py docstring):
             # slots hard-freed at frame-retention must never sit inside the
@@ -449,6 +519,12 @@ class GgrsRunner:
             "resimulated_frames": self.rollback_frames,
             "device_dispatches": self.device_dispatches,
             "donated_dispatches": self.donated_dispatches,
+            "host_uploads": self.host_uploads,
+            "packed": self.packed,
+            "packed_upload_bytes": self.packed_upload_bytes,
+            "megastep": self.megastep,
+            "megastep_dispatches": self.megastep_dispatches,
+            "fused_ring_loads": self.fused_ring_loads,
             "stalled_frames": self.stalled_frames,
             "speculation_hits": getattr(self.spec_cache, "hits", 0),
             "speculation_misses": getattr(self.spec_cache, "misses", 0),
@@ -634,15 +710,29 @@ class GgrsRunner:
             while i < n:
                 r = requests[i]
                 if isinstance(r, LoadRequest):
-                    self._load(r.frame, r.cause)
-                    i += 1
+                    if self.megastep:
+                        # fuse the load into the following run's megastep
+                        # dispatch when its target is device-ring resident
+                        j = i + 1
+                        while j < n and isinstance(
+                            requests[j], (AdvanceRequest, SaveRequest)
+                        ):
+                            j += 1
+                        self._run_megastep(r, requests[i + 1:j])
+                        i = j
+                    else:
+                        self._load(r.frame, r.cause)
+                        i += 1
                 else:
                     j = i
                     while j < n and isinstance(
                         requests[j], (AdvanceRequest, SaveRequest)
                     ):
                         j += 1
-                    self._run_batch(requests[i:j])
+                    if self.megastep:
+                        self._run_megastep(None, requests[i:j])
+                    else:
+                        self._run_batch(requests[i:j])
                     i = j
             # prune AFTER processing (discard_old_snapshots): with coalesced
             # ticks, an early tick's Load can target a frame below a LATER
@@ -658,14 +748,15 @@ class GgrsRunner:
             if self.on_confirmed is not None and self.confirmed != NULL_FRAME:
                 self.on_confirmed(self.confirmed)
 
-    def _load(self, frame: int, cause=None) -> None:
-        """LoadGameState: restore the ring snapshot for ``frame``
-        (schedule_systems.rs:238-249).
+    def _note_rollback(self, frame: int, cause=None) -> None:
+        """Rollback attribution shared by the host-materialize load path and
+        the megastep's fused device-ring load: counters, cause blame, and
+        the always-on flight-recorder entry.
 
-        ``cause`` is the session's :class:`RollbackCause` attribution; when
-        a legacy/replay path supplies none the rollback is attributed to
-        handle ``"unknown"`` so ``rollback_cause_total`` summed over handles
-        always equals ``rollbacks_total``."""
+        ``cause`` is the session's :class:`RollbackCause`; a cause-less
+        legacy/replay load blames handle ``"unknown"`` so
+        ``rollback_cause_total`` summed over handles always equals
+        ``rollbacks_total``."""
         depth = self.frame - frame
         self.rollbacks += 1
         self._phases.note_rollback(depth)
@@ -701,6 +792,16 @@ class GgrsRunner:
             fr.record("rollback", to_frame=frame, from_frame=self.frame,
                       depth=depth, handle=blamed, lateness=lateness,
                       mismatch=mismatch, cause_kind=kind)
+
+    def _load(self, frame: int, cause=None) -> None:
+        """LoadGameState: restore the ring snapshot for ``frame``
+        (schedule_systems.rs:238-249).
+
+        ``cause`` is the session's :class:`RollbackCause` attribution; when
+        a legacy/replay path supplies none the rollback is attributed to
+        handle ``"unknown"`` so ``rollback_cause_total`` summed over handles
+        always equals ``rollbacks_total``."""
+        self._note_rollback(frame, cause)
         with self._phases.phase("rollback_load"), span("LoadWorld"):
             stored, checksum = self.ring.rollback(frame)
             was_lazy = isinstance(stored, LazySlice)
@@ -763,7 +864,47 @@ class GgrsRunner:
         for i, a in enumerate(adv):
             self._stage_inputs[i] = a.inputs
             self._stage_status[i] = a.status
-        return self._stage_inputs[:k], self._stage_status[:k]
+        # the buffers are rewritten next tick: commit synchronously so the
+        # in-flight upload can never read the next tick's bytes
+        from .utils.staging import commit
+
+        return commit(self._stage_inputs[:k]), commit(self._stage_status[:k])
+
+    def _stage_packed_rows(self, adv: List[AdvanceRequest], start_frame: int,
+                           k_pad: Optional[int] = None,
+                           has_load: int = 0, load_slot: int = 0):
+        """Pack a run's advances into the persistent single-upload buffer
+        (ops/packing.py) and return the ``[k_pad + 1, W]`` view: prefix row
+        (frame / n_real / load words) + one payload row per frame.  The
+        fixed-shape programs (canonical, megastep) pass ``k_pad > k``;
+        padded rows repeat the last real row and are masked by ``n_real``."""
+        from .ops.packing import pack_prefix, pack_row, repeat_last_row
+
+        spec = self.app.packed_spec
+        k = len(adv)
+        kp = k_pad if k_pad is not None else k
+        if self._stage_packed is None or self._packed_cap < kp:
+            self._packed_cap = max(kp, self._packed_cap * 2)
+            self._stage_packed = spec.new_buffer(self._packed_cap)
+        buf = self._stage_packed
+        pack_prefix(buf, start_frame, k, has_load, load_slot)
+        for i, a in enumerate(adv):
+            pack_row(spec, buf, i, a.inputs, a.status)
+        repeat_last_row(buf, k, kp)
+        # commit synchronously: the buffer is rewritten next dispatch and
+        # the upload itself is asynchronous (see utils/staging.py)
+        from .utils.staging import commit
+
+        return commit(buf[:kp + 1])
+
+    def _note_dispatch_uploads(self, n: int, packed_buf=None) -> None:
+        """Upload census: ``n`` host->device uploads rode this dispatch
+        (always-on ints + the pre-bound telemetry family)."""
+        self.host_uploads += n
+        self._m_uploads.observe(n)
+        if packed_buf is not None:
+            self.packed_upload_bytes += packed_buf.nbytes
+            self._m_packed_bytes.inc(packed_buf.nbytes)
 
     def _run_batch(self, run: List[GgrsRequest]) -> None:
         """Execute a maximal Advance/Save run as one fused device call.
@@ -816,6 +957,11 @@ class GgrsRunner:
         use_branched = (
             self.spec_cache is not None and self.app.canonical_branches is not None
         )
+        # packed single-upload dispatch (the default): one int8 buffer
+        # replaces the inputs/status/frame upload triple.  The branched
+        # program keeps its own [B, K] shape (app.packed_resim_fn is None
+        # under canonical_branches, so self.packed is already False there).
+        use_packed = self.packed and not use_branched
         # Donation decision + pre-resolution of leading (c==0) saves.  A
         # leading save stores the PRE-dispatch state; donation kills that
         # buffer, so it must be serviceable without pre_world: identity
@@ -835,7 +981,10 @@ class GgrsRunner:
             and self._world_donatable
             and k - skip > 0
             and not use_branched
-            and self.app.resim_fn_donated is not None
+            and (
+                self.app.packed_resim_fn_donated if use_packed
+                else self.app.resim_fn_donated
+            ) is not None
         )
         if donate and leading_saves:
             if identity:
@@ -860,11 +1009,29 @@ class GgrsRunner:
                     "donated_dispatches_total", help="dispatches donating the input world"
                 )
             with span("AdvanceWorld"):
-                with ph.phase("stage_inputs"):
-                    inputs, status = self._stage_rows(adv[skip:])
+                pk = None
+                if use_packed:
+                    # fixed-shape canonical programs take a canonical_depth-
+                    # deep buffer with the real count in the prefix; the
+                    # per-k programs take exactly [k+1, W]
+                    K = self.app.canonical_depth
+                    if K is not None and k - skip > K:
+                        raise ValueError(
+                            f"resim depth {k - skip} exceeds canonical_depth "
+                            f"{K}; raise App(canonical_depth=...) above "
+                            "every session window"
+                        )
+                    with ph.phase("stage_inputs"):
+                        pk = self._stage_packed_rows(
+                            adv[skip:], self.frame, k_pad=K
+                        )
+                else:
+                    with ph.phase("stage_inputs"):
+                        inputs, status = self._stage_rows(adv[skip:])
                 variant = (
                     "branched" if use_branched
-                    else ("donated" if donate else "plain"),
+                    else (("packed_" if use_packed else "")
+                          + ("donated" if donate else "plain")),
                     k - skip,
                 )
                 fresh = variant not in self._seen_variants
@@ -874,6 +1041,16 @@ class GgrsRunner:
                         final, stacked, checks = self._dispatch_branched(
                             inputs, status, adv[-1]
                         )
+                        self._note_dispatch_uploads(4)
+                    elif use_packed:
+                        fn = (
+                            self.app.packed_resim_fn_donated if donate
+                            else self.app.packed_resim_fn
+                        )
+                        if donate:
+                            self.donated_dispatches += 1
+                        final, stacked, checks = fn(self.world, pk)
+                        self._note_dispatch_uploads(1, pk)
                     else:
                         fn = (
                             self.app.resim_fn_donated if donate
@@ -884,6 +1061,7 @@ class GgrsRunner:
                         final, stacked, checks = fn(
                             self.world, inputs, status, self.frame
                         )
+                        self._note_dispatch_uploads(3)
                     batch_checks = BatchChecks(checks)
                     if self.pipeline:
                         # ahead-of-tick readback: the device->host checksum
@@ -982,6 +1160,211 @@ class GgrsRunner:
             self.spec_cache.speculate(
                 last_adv_src, frame_add(self.frame, -1), adv[-1].inputs
             )
+
+    # -- device-resident megastep (ops/megastep.py) -------------------------
+
+    def _ensure_megastep(self) -> None:
+        """Lazily build the megastep program + device ring for the current
+        session (depth formulas mirror ``_ring_depth``/``set_session``: one
+        fixed-shape program per session, so every flush runs the same
+        machine code)."""
+        if self._ms_fn is not None:
+            return
+        from .ops.megastep import init_device_ring, make_megastep_fn
+
+        s = self.session
+        mp = s.max_prediction()
+        window = (
+            s.rollback_window() if hasattr(s, "rollback_window") else mp
+        )
+        # deepest session-shaped run: a rollback landing in the same
+        # coalesced flush as catch-up ticks (the canonical-depth bound in
+        # set_session)
+        self._ms_k = self.coalesce_frames + max(window, mp)
+        # one more slot than the host ring so k_max < R: within a single
+        # dispatch no two written frames share a slot (a duplicate scatter
+        # index would make the writeback order-dependent)
+        self._ms_slots = self._ring_depth(s) + 1
+        app = self.app
+        self._ms_fn = make_megastep_fn(
+            app.reg, app.step, app.packed_spec, app.fps, seed=app.seed,
+            retention=app.retention, k_max=self._ms_k,
+            ring_slots=self._ms_slots,
+        )
+        self._ms_ring, self._ms_ring_frames = init_device_ring(
+            self.world, self._ms_slots
+        )
+        self._dev_frames = {}
+
+    def _dev_slot(self, frame: int) -> Optional[int]:
+        """Device-ring slot currently holding ``frame``, or None when the
+        frame was overwritten / never written.  The host mirror makes the
+        check exact: a miss degrades to the host materialize path, never to
+        a wrong row.  Python ``%`` is non-negative like jnp's (divisor-sign)
+        ``%``, so wrapped int32 frames map to the same slot on both sides."""
+        slot = frame % self._ms_slots
+        return slot if self._dev_frames.get(slot) == frame else None
+
+    def _run_megastep(
+        self, load: Optional[LoadRequest], run: List[GgrsRequest]
+    ) -> None:
+        """Megastep flush: an optional LoadRequest plus its following
+        Advance/Save run as ONE device dispatch fed by ONE packed upload —
+        including the rollback itself, when its target frame is still
+        resident in the on-device snapshot ring (ops/megastep.py)."""
+        self._ensure_megastep()
+        ph = self._phases
+        n_adv = sum(1 for r in run if isinstance(r, AdvanceRequest))
+        has_load = 0
+        load_slot = 0
+        loaded_pair = None
+        if load is not None:
+            slot = self._dev_slot(load.frame) if n_adv > 0 else None
+            if slot is None:
+                # ring miss (or a load with nothing to replay): host
+                # materialize path — bit-identical, one extra dispatch
+                self._load(load.frame, load.cause)
+            else:
+                self._note_rollback(load.frame, load.cause)
+                with ph.phase("rollback_load"), span("LoadWorld"):
+                    # bookkeeping only: pop newer host-ring entries and take
+                    # the checksum handle; the STATE restore happens inside
+                    # the megastep dispatch (no materialize, no extra
+                    # dispatch, no host sync)
+                    stored, checksum = self.ring.rollback(load.frame)
+                    loaded_pair = (stored, checksum)
+                    self._world_checksum = checksum
+                    self.frame = load.frame
+                self.fused_ring_loads += 1
+                telemetry.count(
+                    "fused_ring_loads_total",
+                    help="rollback loads served from the device ring inside "
+                         "the megastep dispatch",
+                )
+                has_load = 1
+                load_slot = slot
+                self._last_stacked = None
+                self._last_stacked_frame = None
+        if not run:
+            return
+        # chunk the run so each dispatch carries at most k_max advances
+        # (session-shaped runs always fit — k_max covers a maximal rollback
+        # + coalesced catch-up — but replay/tool request lists can be longer)
+        i, n = 0, len(run)
+        while i < n:
+            j, c = i, 0
+            while j < n:
+                if isinstance(run[j], AdvanceRequest):
+                    if c == self._ms_k:
+                        break
+                    c += 1
+                j += 1
+            self._megastep_chunk(run[i:j], has_load, load_slot, loaded_pair)
+            has_load, load_slot, loaded_pair = 0, 0, None
+            i = j
+
+    def _megastep_chunk(
+        self, run: List[GgrsRequest], has_load: int, load_slot: int,
+        loaded_pair,
+    ) -> None:
+        """One megastep dispatch: <= k_max advances (+ interleaved saves),
+        optionally consuming a fused device-ring load in the same program."""
+        ph = self._phases
+        adv = [r for r in run if isinstance(r, AdvanceRequest)]
+        k = len(adv)
+        ph.note_advances(k)
+        if not hasattr(self._world_checksum, "to_int"):
+            self._world_checksum = wrap_single_checksum(self._world_checksum)
+        pre_world, pre_checksum = self.world, self._world_checksum
+        pre_frame = self.frame
+        if self.on_advance is not None:
+            for i, a in enumerate(adv):
+                self.on_advance(frame_add(pre_frame, i + 1), a.inputs, a.status)
+        stacked = None
+        batch_checks = None
+        if k > 0:
+            self.device_dispatches += 1
+            self.megastep_dispatches += 1
+            self.rollback_frames += max(k - 1, 0)
+            telemetry.count("device_dispatches_total", help="fused resim dispatches")
+            telemetry.count(
+                "resim_frames_total", max(k - 1, 0),
+                help="frames resimulated beyond the first of each dispatch",
+            )
+            with span("AdvanceWorld"):
+                with ph.phase("stage_inputs"):
+                    pk = self._stage_packed_rows(
+                        adv, self.frame, k_pad=self._ms_k,
+                        has_load=has_load, load_slot=load_slot,
+                    )
+                variant = ("megastep", self._ms_k)
+                fresh = variant not in self._seen_variants
+                t_build = time.perf_counter() if fresh else 0.0
+                with ph.phase("wave_dispatch"):
+                    (final, self._ms_ring, self._ms_ring_frames, stacked,
+                     checks) = self._ms_fn(
+                        self.world, self._ms_ring, self._ms_ring_frames, pk
+                    )
+                    self._note_dispatch_uploads(1, pk)
+                    batch_checks = BatchChecks(checks)
+                    if self.pipeline:
+                        self._rbq.start(batch_checks)
+                if fresh:
+                    self._note_compile(variant, time.perf_counter() - t_build)
+                # host mirror of the device ring writeback (slot -> frame)
+                R = self._ms_slots
+                for i in range(k):
+                    f = frame_add(self.frame, i + 1)
+                    self._dev_frames[f % R] = f
+                self.world = final
+                self._world_checksum = batch_checks.ref(k - 1)
+                self.frame = frame_add(self.frame, k)
+        materialize_saves = False
+        if stacked is not None:
+            key = ("megastep", self._ms_k)
+            stacked_bytes = self._stacked_bytes_by_k.get(key)
+            if stacked_bytes is None:
+                from .utils.mem import tree_device_bytes
+
+                stacked_bytes = tree_device_bytes(stacked)
+                self._stacked_bytes_by_k[key] = stacked_bytes
+            materialize_saves = stacked_bytes > self.ring_materialize_bytes
+            telemetry.gauge_set(
+                "save_bytes", stacked_bytes,
+                "device bytes of the last dispatch's stacked save buffer",
+            )
+            telemetry.record(
+                "dispatch", frame=self.frame, advances=k, skipped=0,
+                donated=False, save_bytes=stacked_bytes, megastep=True,
+            )
+        with ph.phase("store_save"), span("SaveWorld"):
+            c = 0  # advances seen so far within the run
+            for r in run:
+                if isinstance(r, AdvanceRequest):
+                    c += 1
+                    continue
+                if c == 0:
+                    if loaded_pair is not None:
+                        # leading save after a fused ring load: self.world
+                        # was NOT updated host-side (the device selected the
+                        # ring row), so re-push the rollback's own handle —
+                        # the exact state/checksum the host path would store
+                        state_s, cs = loaded_pair
+                    else:
+                        state_s, cs = pre_world, pre_checksum
+                        if self._world is pre_world:
+                            # ring aliases the live world (donation is
+                            # already off in megastep mode; kept for parity)
+                            self._world_donatable = False
+                else:
+                    # megastep requires identity strategies (ctor), so the
+                    # lazy stacked-row handle IS the stored representation
+                    state_s = LazySlice(stacked, c - 1)
+                    if materialize_saves:
+                        state_s = state_s.materialize()
+                    cs = batch_checks.ref(c - 1)
+                self.ring.push(r.frame, (state_s, cs))
+                r.cell.save(r.frame, cs)
 
     def _note_compile(self, variant, dt: float) -> None:
         """Record a program variant's first-dispatch wall time (trace +

@@ -231,6 +231,12 @@ class SyncTestSession:
             if len(vals) > 1:
                 mismatched.append(frame)
         if mismatched:
+            import os
+            if os.environ.get("BGT_DEBUG_MISMATCH"):
+                for fr in mismatched:
+                    print(f"MISMATCH frame {fr}: "
+                          f"{[hex(v) if isinstance(v, int) else v for v in self._cells[fr]]}",
+                          flush=True)
             frames = sorted(mismatched)
             telemetry.count(
                 "checksum_mismatch_total", len(frames),

@@ -1,0 +1,32 @@
+"""Synchronous host->device commit for persistent staging buffers.
+
+jax transfers host (numpy) arguments asynchronously — both ``device_put``
+and jit argument commits return before the copy lands.  A persistent
+staging buffer that is rewritten on the next tick can therefore race an
+in-flight upload: under scheduler pressure the transfer reads the NEXT
+tick's bytes, which surfaces as a bit-stable-but-wrong checksum (the
+SyncTest oracle catches it as a mismatch on an early frame, since the
+widest window is the first dispatch's compile stall).
+
+``commit`` starts the copy and blocks until the TRANSFER (not any
+dependent computation) completes, so the caller may immediately reuse the
+host buffer while the dispatch itself stays fully asynchronous.  Every
+reused staging buffer — packed or three-upload — must pass through here
+before it reaches a jitted program."""
+
+from __future__ import annotations
+
+import jax
+
+
+def commit(buf, sharding=None):
+    """Upload ``buf`` and wait for the copy; returns the device array."""
+    x = (
+        jax.device_put(buf, sharding)
+        if sharding is not None
+        else jax.device_put(buf)
+    )
+    # bgt: ignore[BGT011]: deliberate — blocks on the TRANSFER only, which
+    # is what makes persistent staging-buffer reuse safe (module docstring)
+    x.block_until_ready()
+    return x

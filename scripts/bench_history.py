@@ -12,9 +12,15 @@ Rules that keep the gate honest on this heterogeneous history:
 - only records with ``rc == 0`` count (a crashed round proves nothing);
 - only prior records on the SAME platform as the latest are compared
   (a CPU round "regressing" against a TPU round is not a regression);
-- only higher-is-better throughput metrics participate (``*fps*``,
-  ``*per_sec*``, ``*speedup*``, and the headline ``value``) — spreads,
-  byte counts and percentages are reported by bench.py but not gated;
+- higher-is-better throughput metrics participate (``*fps*``,
+  ``*per_sec*``, ``*speedup*``, ``*frames_per_dispatch*``, and the headline
+  ``value``) — spreads, byte counts and percentages are reported by
+  bench.py but not gated;
+- LOWER-is-better upload-census metrics (``*uploads_per_tick*``,
+  ``*dispatches_per_tick*``, ``*uploads_per_flush*`` from the ``uploads``
+  stage) gate in the opposite direction: the latest is compared against the
+  best (lowest) prior and an increase past the threshold fails — their
+  table delta is printed as "goodness" (negative = got worse);
 - metrics the latest record does not carry are skipped, not failed
   (stage sets grew over rounds — r01 had no batched stage).
 
@@ -34,9 +40,19 @@ import re
 import sys
 
 # higher-is-better selector: any numeric parsed key matching one of these is
-# a gated throughput metric ("value" is the headline resim fps)
-_METRIC_RE = re.compile(r"(fps|per_sec|speedup|ticks_per_sec)")
+# a gated throughput metric ("value" is the headline resim fps);
+# frames_per_dispatch is the megastep flatness ratio (~N when every flush
+# retires as one dispatch — falling means the fused program split)
+_METRIC_RE = re.compile(r"(fps|per_sec|speedup|ticks_per_sec|"
+                        r"frames_per_dispatch)")
 _EXCLUDE_RE = re.compile(r"(spread|bytes|pct|entities|depth|reps|lobbies)")
+
+# LOWER-is-better floor metrics: the packed/megastep upload censuses
+# (bench.py stage_uploads) must hold at 1.0 per tick / per flush — an
+# INCREASE past the threshold is the regression (a staging path grew an
+# extra host->device upload or split a dispatch)
+_FLOOR_RE = re.compile(r"(uploads_per_tick|dispatches_per_tick|"
+                       r"uploads_per_flush)")
 
 
 def load_records(dir: str) -> list:
@@ -82,9 +98,20 @@ def throughput_metrics(parsed: dict) -> dict:
     for k, v in _flatten(parsed).items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue
-        if _EXCLUDE_RE.search(k):
+        if _EXCLUDE_RE.search(k) or _FLOOR_RE.search(k):
             continue
         if k == "value" or _METRIC_RE.search(k):
+            out[k] = float(v)
+    return out
+
+
+def floor_metrics(parsed: dict) -> dict:
+    """The gated LOWER-is-better census metrics of one parsed record."""
+    out = {}
+    for k, v in _flatten(parsed).items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if _FLOOR_RE.search(k):
             out[k] = float(v)
     return out
 
@@ -101,22 +128,31 @@ def compare(records: list, threshold: float) -> tuple:
         (n, p) for n, p in records[:-1]
         if platform is None or p.get("platform") == platform
     ]
-    latest_m = throughput_metrics(latest)
     rows, regressions = [], []
-    for metric in sorted(latest_m):
-        best = best_round = None
-        for n, p in priors:
-            v = throughput_metrics(p).get(metric)
-            if v is not None and v > 0 and (best is None or v > best):
-                best, best_round = v, n
-        if best is None:
-            rows.append((metric, None, None, latest_m[metric], None))
-            continue
-        delta = (latest_m[metric] - best) / best
-        row = (metric, best, best_round, latest_m[metric], delta)
-        rows.append(row)
-        if delta < -threshold:
-            regressions.append(row)
+    for extract, lower_is_better in ((throughput_metrics, False),
+                                     (floor_metrics, True)):
+        latest_m = extract(latest)
+        for metric in sorted(latest_m):
+            best = best_round = None
+            for n, p in priors:
+                v = extract(p).get(metric)
+                if v is None or v <= 0:
+                    continue
+                if best is None or (v < best if lower_is_better
+                                    else v > best):
+                    best, best_round = v, n
+            if best is None:
+                rows.append((metric, None, None, latest_m[metric], None))
+                continue
+            # delta is always "goodness": negative = got worse, so the
+            # single `< -threshold` regression test covers both directions
+            delta = (latest_m[metric] - best) / best
+            if lower_is_better:
+                delta = -delta
+            row = (metric, best, best_round, latest_m[metric], delta)
+            rows.append(row)
+            if delta < -threshold:
+                regressions.append(row)
     return (latest_round, platform, rows, regressions)
 
 

@@ -164,6 +164,18 @@ def main():
         print(_tel.format_phase_table(
             _tel.phase_breakdown(_tel.flight_recorder().snapshot("tick"))
         ))
+    # upload census: packed staging cost shows under stage_inputs above;
+    # this is the denominator that says whether it bought the single-upload
+    # shape (docs/dispatch_floor.md "Packed uploads")
+    st0 = runners[0].stats()
+    d = st0["device_dispatches"]
+    print(f"upload census: {st0['host_uploads']} host uploads / {d} "
+          f"dispatches = {st0['host_uploads'] / max(d, 1):.2f} per dispatch "
+          f"(packed={st0['packed']}, "
+          f"{st0['packed_upload_bytes']} packed bytes"
+          + (f", megastep: {st0['megastep_dispatches']} fused chunks, "
+             f"{st0['fused_ring_loads']} ring loads" if st0["megastep"]
+             else "") + ")")
     print(f"device trace written to {args.logdir} (view with xprof/"
           f"tensorboard)")
     if args.telemetry_out:

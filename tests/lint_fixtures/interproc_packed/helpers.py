@@ -1,0 +1,5 @@
+from .leaf import upload
+
+
+def commit_staging(buf):
+    return upload(buf)

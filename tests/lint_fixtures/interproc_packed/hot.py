@@ -1,0 +1,8 @@
+"""Packed-staging driver shape: the per-tick dispatch path stages rows
+into a persistent packed buffer and commits it through a helper — no
+forcing syntax in this file, the chain hides in the staging commit."""
+from .helpers import commit_staging
+
+
+def stage_packed_rows(buf, k):
+    return commit_staging(buf[:k + 1])

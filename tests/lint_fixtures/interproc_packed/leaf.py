@@ -1,0 +1,3 @@
+def upload(buf):
+    x = buf.device_put_result()
+    return x.block_until_ready()

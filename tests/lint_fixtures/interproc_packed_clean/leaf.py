@@ -1,0 +1,2 @@
+def upload(buf):
+    return buf

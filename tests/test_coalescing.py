@@ -18,7 +18,8 @@ from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
 DT = 1.0 / 60.0
 
 
-def _synctest_driver(coalesce, ticks=36, chunk=1):
+def _synctest_driver(coalesce, ticks=36, chunk=1, pipeline=True,
+                     before_finish=None):
     app = fixed_point.make_app()
     session = SyncTestSession(
         num_players=2, input_shape=(), input_dtype=np.uint8,
@@ -34,13 +35,15 @@ def _synctest_driver(coalesce, ticks=36, chunk=1):
     runner = GgrsRunner(
         app, session, read_inputs=read_inputs,
         on_mismatch=lambda e: (_ for _ in ()).throw(e),
-        coalesce_frames=coalesce,
+        coalesce_frames=coalesce, pipeline=pipeline,
     )
     done = 0
     while done < ticks:
         n = min(chunk, ticks - done)
         runner.update(n * DT)  # n due frames in one host update
         done += n
+    if before_finish is not None:
+        before_finish(runner)
     runner.finish()
     return runner
 
@@ -60,6 +63,31 @@ def test_coalesced_synctest_bit_identical_and_fewer_dispatches():
     # the point of the feature: 4-frame chunks collapse into fewer dispatches
     assert fused.device_dispatches < plain.device_dispatches
     assert fused.ticks == plain.ticks
+
+
+def test_coalesced_pipelined_bit_identical_without_forced_readbacks():
+    """coalesce>1 composed with the tick pipeline: the async checksum
+    readback must keep up with fused k>1 dispatches — bit-equal to the
+    synchronous per-tick driver with ZERO forced (blocking) pulls during
+    the run (finish() drains are excluded from the window)."""
+    from bevy_ggrs_tpu.snapshot.lazy import readback_stats
+
+    sync = _synctest_driver(coalesce=1, chunk=1, pipeline=False)
+    before = readback_stats()
+    window = {}
+    piped = _synctest_driver(
+        coalesce=4, chunk=4, pipeline=True,
+        before_finish=lambda r: window.update(readback_stats()),
+    )
+    assert window["forced"] - before["forced"] == 0
+    assert piped.frame == sync.frame
+    assert piped.checksum == sync.checksum
+    shared = sorted(set(sync.ring.frames()) & set(piped.ring.frames()))
+    assert shared
+    for f in shared:
+        assert checksum_to_int(sync.ring.peek(f)[1]) == checksum_to_int(
+            piped.ring.peek(f)[1]
+        )
 
 
 def test_coalesce_frames_one_is_the_reference_cadence():

@@ -183,6 +183,37 @@ def test_bgt011_clean_chain_is_clean():
     assert only(findings, "BGT011") == []
 
 
+def test_bgt011_packed_staging_chain_flagged():
+    """The packed single-upload hot path's exact shape: stage_packed_rows
+    -> commit_staging -> upload, with the forcing (the synchronous staging
+    commit that makes persistent-buffer reuse safe) two calls deep.  The
+    analyzer must surface it at the driver call site with the full chain —
+    the real bevy_ggrs_tpu/utils/staging.py commit is sanctioned at its
+    seed line, and this fixture is what proves that sanction is load-
+    bearing rather than the chain being invisible."""
+    import ast
+
+    hot = FIXTURES / "interproc_packed" / "hot.py"
+    assert check_purity(ast.parse(hot.read_text()), allow=set()) == [], \
+        "the intra-function check must provably miss the staging chain"
+
+    findings = lint_paths(_interproc_paths("interproc_packed"),
+                          **_interproc_cfg("interproc_packed"))
+    hits = only(findings, "BGT011")
+    assert len(hits) == 1, [f.as_dict() for f in findings]
+    f = hits[0]
+    assert f.path.endswith("interproc_packed/hot.py") and not f.suppressed
+    for fragment in ("stage_packed_rows", "commit_staging", "upload",
+                     "block_until_ready", "leaf.py"):
+        assert fragment in f.message, f.message
+
+
+def test_bgt011_packed_staging_clean_chain_is_clean():
+    findings = lint_paths(_interproc_paths("interproc_packed_clean"),
+                          **_interproc_cfg("interproc_packed_clean"))
+    assert only(findings, "BGT011") == []
+
+
 # -- stale-allowlist meta-lint (BGT012) ---------------------------------------
 
 

@@ -1,0 +1,267 @@
+"""Device-resident N-tick megastep (ops/megastep.py + GgrsRunner(megastep=
+True)): a whole coalesced flush — rollback load included, when its target is
+still resident in the on-device snapshot ring — runs as ONE dispatch fed by
+ONE packed upload.
+
+Acceptance oracle: the per-tick packed driver.  The megastep program must be
+bit-identical to it (SyncTest checksums + ring contents), fused ring loads
+must actually engage under the SyncTest every-tick rollback cadence, and the
+steady predicted P2P shape must hit the headline cost: N frames per update =
+1 dispatch + 1 upload."""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import (
+    GgrsRunner,
+    PlayerType,
+    SessionBuilder,
+    SessionState,
+    SpeculationConfig,
+    SyncTestSession,
+)
+from bevy_ggrs_tpu.models import fixed_point, stress
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+DT = 1.0 / 60.0
+
+
+def _drive(megastep, coalesce=1, ticks=36, chunk=1, check_distance=3):
+    app = fixed_point.make_app()
+    session = SyncTestSession(
+        num_players=2, input_shape=(), input_dtype=np.uint8,
+        check_distance=check_distance, compare_interval=1,
+    )
+    t = [0]
+
+    def read_inputs(handles):
+        t[0] += 1
+        return {h: np.uint8((t[0] * 7 + h * 3) & 0xF) for h in handles}
+
+    runner = GgrsRunner(
+        app, session, read_inputs=read_inputs,
+        on_mismatch=lambda e: (_ for _ in ()).throw(e),
+        coalesce_frames=coalesce, megastep=megastep,
+    )
+    done = 0
+    while done < ticks:
+        n = min(chunk, ticks - done)
+        runner.update(n * DT)
+        done += n
+    runner.finish()
+    return runner
+
+
+def _assert_bit_identical(a, b):
+    assert a.frame == b.frame
+    assert a.checksum == b.checksum
+    shared = sorted(set(a.ring.frames()) & set(b.ring.frames()))
+    assert shared
+    for f in shared:
+        assert checksum_to_int(a.ring.peek(f)[1]) == checksum_to_int(
+            b.ring.peek(f)[1]
+        )
+
+
+def test_megastep_synctest_bit_identical():
+    """SyncTest rolls back EVERY tick, so each flush carries a Load — the
+    fused device-ring select must restore bit-exactly what the host ring
+    path restores."""
+    ms = _drive(megastep=True)
+    ref = _drive(megastep=False)
+    _assert_bit_identical(ms, ref)
+    st = ms.stats()
+    assert st["megastep"] and st["fused_ring_loads"] > 0
+    assert st["megastep_dispatches"] > 0
+    # every megastep dispatch is fed by exactly one packed upload
+    assert st["host_uploads"] == st["device_dispatches"]
+
+
+def test_megastep_coalesced_bit_identical():
+    """coalesce=8 chunks: one flush = Load + 8-frame catch-up in a single
+    fixed-shape dispatch (SyncTest keeps interleaving loads, so dispatch
+    count stays O(flushes), not O(frames))."""
+    ms = _drive(megastep=True, coalesce=8, ticks=48, chunk=8,
+                check_distance=8)
+    ref = _drive(megastep=False, coalesce=8, ticks=48, chunk=8,
+                 check_distance=8)
+    _assert_bit_identical(ms, ref)
+    st = ms.stats()
+    assert st["fused_ring_loads"] > 0
+    assert st["host_uploads"] == st["device_dispatches"]
+    # SyncTest loads every tick, so fusion cannot beat the coalesced
+    # reference here — but it must never dispatch MORE (the steady P2P
+    # test below owns the 1-dispatch-per-N headline)
+    assert st["device_dispatches"] <= ref.stats()["device_dispatches"]
+
+
+def _p2p_pair(coalesce, megastep):
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    net = ChannelNetwork(seed=21)
+    socks = [net.endpoint(f"m{i}") for i in range(2)]
+    runners = []
+    for i in range(2):
+        app = fixed_point.make_app()
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(2)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"m{1 - i}")
+        )
+        session = b.start_p2p_session(socks[i])
+        runners.append(GgrsRunner(
+            app, session,
+            # constant inputs: PredictRepeatLast is always right, so the
+            # steady state has NO rollbacks — the pure megastep cadence
+            read_inputs=lambda hs: {h: np.uint8(3) for h in hs},
+            coalesce_frames=coalesce, megastep=megastep,
+        ))
+    for _ in range(500):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING
+               for r in runners):
+            break
+    assert all(r.session.current_state() == SessionState.RUNNING
+               for r in runners)
+    return net, runners
+
+
+def test_megastep_steady_p2p_one_dispatch_per_n_ticks():
+    """The headline number: N coalesced frames per host update cost exactly
+    ONE dispatch fed by ONE upload once prediction holds."""
+    N = 8
+    net, runners = _p2p_pair(coalesce=N, megastep=True)
+    # settle the startup transient (predictions confirmed, rings warm)
+    for _ in range(6):
+        net.deliver()
+        for r in runners:
+            r.update(N * DT)
+    r0 = runners[0]
+    rb0 = r0.rollbacks
+    flushes = 10
+    exact = 0
+    for _ in range(flushes):
+        d0, u0, f0 = (r0.device_dispatches, r0.stats()["host_uploads"],
+                      r0.frame)
+        net.deliver()
+        for r in runners:
+            r.update(N * DT)
+        # float accumulator drift can make a flush owe N±1 frames; every
+        # flush that owes exactly N must cost exactly 1 dispatch + 1 upload
+        if r0.frame - f0 == N:
+            assert r0.device_dispatches - d0 == 1
+            assert r0.stats()["host_uploads"] - u0 == 1
+            exact += 1
+    # frame-advantage throttling makes a few flushes owe N±1; the
+    # exactly-N shape (asserted 1+1 above) must still dominate
+    assert exact >= flushes // 2
+    assert r0.rollbacks == rb0  # constant inputs: prediction never misses
+    # align the peers frame-for-frame and compare live checksums (the
+    # fast-confirming peer prunes its ring too eagerly for row-level
+    # comparison; bit-equality vs the host-ring driver is owned by the
+    # SyncTest tests above)
+    for _ in range(200):
+        if runners[0].frame == runners[1].frame:
+            break
+        net.deliver()
+        behind = min(runners, key=lambda r: r.frame)
+        behind.update(DT)
+    assert runners[0].frame == runners[1].frame
+    assert runners[0].checksum == runners[1].checksum
+    for r in runners:
+        r.finish()
+
+
+def test_megastep_p2p_with_rollbacks_matches_per_tick_driver():
+    """Flipping inputs under channel latency: rollbacks land inside the
+    coalesced flushes, exercising the fused ring-load path end-to-end; the
+    megastep peer must stay bit-identical to its per-tick packed partner
+    (cross-peer ring agreement is the oracle)."""
+    from bevy_ggrs_tpu.session.channel import ChannelNetwork
+
+    net = ChannelNetwork(latency_hops=3, seed=5)
+    socks = [net.endpoint(f"x{i}") for i in range(2)]
+    runners = []
+    for i, (ms, co) in enumerate([(True, 4), (False, 1)]):
+        app = fixed_point.make_app()
+        b = (
+            SessionBuilder.for_app(app)
+            .with_input_delay(1)
+            .add_player(PlayerType.LOCAL, i)
+            .add_player(PlayerType.REMOTE, 1 - i, f"x{1 - i}")
+        )
+        session = b.start_p2p_session(socks[i])
+        flip = [0]
+
+        def read_inputs(hs, flip=flip, i=i):
+            flip[0] += 1
+            return {h: np.uint8((flip[0] // 5 + i) & 0x7) for h in hs}
+
+        runners.append(GgrsRunner(
+            app, session, read_inputs=read_inputs,
+            coalesce_frames=co, megastep=ms,
+        ))
+    for _ in range(500):
+        net.deliver()
+        for r in runners:
+            r.update(0.0)
+        if all(r.session.current_state() == SessionState.RUNNING
+               for r in runners):
+            break
+    for step in range(120):
+        net.deliver()
+        runners[1].update(DT)
+        if step % 4 == 3:
+            runners[0].update(4 * DT)
+    assert runners[0].rollbacks > 0, "latency never forced a rollback"
+    # keep ticking in lockstep so both confirmation frontiers overtake some
+    # mutually retained frames — speculative ring rows may legitimately
+    # differ, only both-confirmed ones are the oracle
+    from bevy_ggrs_tpu.utils.frames import frame_lt
+
+    shared = []
+    for _ in range(40):
+        net.deliver()
+        for r in runners:
+            r.update(DT)
+        horizon = min(r.confirmed for r in runners)
+        shared = sorted(
+            f for f in set(runners[0].ring.frames())
+            & set(runners[1].ring.frames())
+            if not frame_lt(horizon, f)
+        )
+        if shared:
+            break
+    assert shared
+    for f in shared:
+        assert checksum_to_int(runners[0].ring.peek(f)[1]) == checksum_to_int(
+            runners[1].ring.peek(f)[1]
+        )
+    for r in runners:
+        r.finish()
+
+
+def test_megastep_construction_guards():
+    app = fixed_point.make_app()
+    sess = SyncTestSession(
+        num_players=2, input_shape=(), input_dtype=np.uint8,
+        check_distance=3, compare_interval=1,
+    )
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        GgrsRunner(
+            app, sess, megastep=True,
+            speculation=SpeculationConfig(
+                candidates_fn=lambda used: used[None], depth=1
+            ),
+        )
+    capp = stress.make_app(64, capacity=64)
+    capp.canonical_depth = 8
+    capp.canonical_branches = 4
+    with pytest.raises(ValueError, match="canonical_branches"):
+        GgrsRunner(capp, SyncTestSession(
+            num_players=2, input_shape=(), input_dtype=np.uint8,
+            check_distance=3, compare_interval=1,
+        ), megastep=True)

@@ -1,0 +1,223 @@
+"""Packed single-upload staging (ops/packing.py): host pack / device unpack
+roundtrip, bit-equality of the packed resim path against the three-upload
+reference on solo / canonical / batched / sharded drivers, and the upload
+census the bench "uploads" stage gates on (steady tick = ONE host->device
+upload feeding ONE fused dispatch)."""
+
+import jax
+import numpy as np
+
+from bevy_ggrs_tpu import GgrsRunner, SyncTestSession
+from bevy_ggrs_tpu.models import fixed_point, stress
+from bevy_ggrs_tpu.ops.packing import (
+    PREFIX_BYTES,
+    PackedSpec,
+    pack_prefix,
+    pack_row,
+    repeat_last_row,
+    unpack_seq,
+)
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+
+# ----------------------------------------------------- pack/unpack roundtrip
+
+
+def _roundtrip(spec, k, rng):
+    if np.issubdtype(spec.input_dtype, np.floating):
+        inputs = rng.standard_normal(
+            (k, spec.players, *spec.input_shape)
+        ).astype(spec.input_dtype)
+    else:
+        info = np.iinfo(spec.input_dtype)
+        inputs = rng.integers(
+            info.min, info.max, (k, spec.players, *spec.input_shape),
+            dtype=spec.input_dtype, endpoint=True,
+        )
+    status = rng.integers(0, 3, (k, spec.players), dtype=np.int8)
+    buf = spec.new_buffer(k)
+    pack_prefix(buf, start_frame=1234, n_real=k, has_load=1, load_slot=5)
+    for i in range(k):
+        pack_row(spec, buf, i, inputs[i], status[i])
+    out = jax.jit(lambda p: unpack_seq(spec, p))(buf)
+    got_inputs, got_status, start, n_real, has_load, load_slot = out
+    np.testing.assert_array_equal(np.asarray(got_inputs), inputs)
+    np.testing.assert_array_equal(np.asarray(got_status), status)
+    assert int(start) == 1234 and int(n_real) == k
+    assert int(has_load) == 1 and int(load_slot) == 5
+
+
+def test_roundtrip_scalar_uint8():
+    _roundtrip(PackedSpec.from_parts(2, (), np.uint8), 5,
+               np.random.default_rng(0))
+
+
+def test_roundtrip_multibyte_vector_dtypes():
+    # multi-byte itemsizes exercise the reshape-before-bitcast path
+    rng = np.random.default_rng(1)
+    _roundtrip(PackedSpec.from_parts(3, (4,), np.int16), 3, rng)
+    _roundtrip(PackedSpec.from_parts(2, (2, 2), np.float32), 4, rng)
+
+
+def test_prefix_is_negative_frame_safe():
+    # wrapped frames are negative int32s; the .view write must roundtrip them
+    spec = PackedSpec.from_parts(2, (), np.uint8)
+    buf = spec.new_buffer(1)
+    pack_prefix(buf, start_frame=-7, n_real=1)
+    pack_row(spec, buf, 0, np.zeros(2, np.uint8), np.zeros(2, np.int8))
+    _, _, start, _, _, _ = jax.jit(lambda p: unpack_seq(spec, p))(buf)
+    assert int(start) == -7
+
+
+def test_repeat_last_row_pads_with_final_real_row():
+    spec = PackedSpec.from_parts(2, (), np.uint8)
+    buf = spec.new_buffer(6)
+    for i in range(3):
+        pack_row(spec, buf, i, np.full(2, 10 + i, np.uint8),
+                 np.zeros(2, np.int8))
+    repeat_last_row(buf, 3, 6)
+    for row in range(4, 7):  # padded payload rows 3..5 live at indices 4..6
+        np.testing.assert_array_equal(buf[row], buf[3])
+
+
+def test_width_is_prefix_and_word_aligned():
+    spec = PackedSpec.from_parts(1, (), np.uint8)  # payload 2 < prefix 16
+    assert spec.width >= PREFIX_BYTES and spec.width % 4 == 0
+    big = PackedSpec.from_parts(4, (5,), np.float32)  # payload 84
+    assert big.width == 84  # already word-aligned
+
+
+# -------------------------------------- solo driver: packed == three-upload
+
+
+def _synctest_driver(app_fn, packed, ticks=36, **kw):
+    app = app_fn()
+    session = SyncTestSession(
+        num_players=2, input_shape=(), input_dtype=np.uint8,
+        check_distance=3, compare_interval=1,
+    )
+    t = [0]
+
+    def read_inputs(handles):
+        t[0] += 1
+        return {h: np.uint8((t[0] * 7 + h * 3) & 0xF) for h in handles}
+
+    runner = GgrsRunner(
+        app, session, read_inputs=read_inputs,
+        on_mismatch=lambda e: (_ for _ in ()).throw(e),
+        packed=packed, **kw,
+    )
+    for _ in range(ticks):
+        runner.tick()
+    runner.finish()
+    return runner
+
+
+def _assert_bit_identical(a, b):
+    assert a.frame == b.frame
+    assert a.checksum == b.checksum
+    shared = sorted(set(a.ring.frames()) & set(b.ring.frames()))
+    assert shared
+    for f in shared:
+        assert checksum_to_int(a.ring.peek(f)[1]) == checksum_to_int(
+            b.ring.peek(f)[1]
+        )
+
+
+def test_packed_solo_bit_identical_to_unpacked():
+    packed = _synctest_driver(fixed_point.make_app, packed=True)
+    plain = _synctest_driver(fixed_point.make_app, packed=False)
+    assert packed.packed and not plain.packed
+    _assert_bit_identical(packed, plain)
+
+
+def test_packed_upload_census_one_per_dispatch():
+    packed = _synctest_driver(fixed_point.make_app, packed=True)
+    st = packed.stats()
+    # the tentpole invariant: every fused dispatch fed by EXACTLY one upload
+    assert st["host_uploads"] == st["device_dispatches"]
+    assert st["packed_upload_bytes"] > 0
+    plain = _synctest_driver(fixed_point.make_app, packed=False)
+    stp = plain.stats()
+    assert stp["host_uploads"] == 3 * stp["device_dispatches"]
+    assert stp["packed_upload_bytes"] == 0
+
+
+def test_packed_canonical_bit_identical():
+    def make_canonical():
+        app = stress.make_app(64, capacity=64)
+        app.canonical_depth = 8
+        return app
+
+    packed = _synctest_driver(make_canonical, packed=True)
+    plain = _synctest_driver(make_canonical, packed=False)
+    assert packed.packed  # canonical_depth keeps a packed program
+    _assert_bit_identical(packed, plain)
+    st = packed.stats()
+    assert st["host_uploads"] == st["device_dispatches"]
+
+
+def test_packed_falls_back_without_packed_program():
+    # canonical_branches mode ships no packed program: packed=True must
+    # degrade to the three-upload path, not crash
+    app = stress.make_app(64, capacity=64)
+    app.canonical_depth = 8
+    app.canonical_branches = 4
+    assert app.packed_resim_fn is None
+    runner = _synctest_driver(lambda: app, packed=True, ticks=12)
+    assert runner.packed is False
+    assert runner.stats()["host_uploads"] > 0  # census still counts
+
+
+# -------------------------------------------------- batched / sharded waves
+
+
+def _drive_batched(packed, m=3, ticks=24, mesh=None):
+    from bevy_ggrs_tpu import BatchedRunner
+
+    app = fixed_point.make_app()
+    t = [0]
+
+    def read_inputs(lobby, handles):
+        rng = np.random.default_rng(1000 * lobby + t[0])
+        return {h: np.uint8(rng.integers(0, 16)) for h in handles}
+
+    sessions = [
+        SyncTestSession(num_players=2, input_shape=(), input_dtype=np.uint8,
+                        check_distance=2, compare_interval=1)
+        for _ in range(m)
+    ]
+    br = BatchedRunner(app, sessions, read_inputs=read_inputs,
+                       packed=packed, mesh=mesh)
+    sums = [[] for _ in range(m)]
+    for _ in range(ticks):
+        br.tick()
+        t[0] += 1
+        for b in range(m):
+            sums[b].append(br.lobby_checksum(b))
+    br.finish()  # SyncTest oracle: raises on any batched-restore mismatch
+    return br, sums
+
+
+def test_batched_packed_bit_identical_to_unpacked():
+    a, a_sums = _drive_batched(packed=True)
+    b, b_sums = _drive_batched(packed=False)
+    assert a.stats()["packed"] and not b.stats()["packed"]
+    assert a_sums == b_sums
+    ea, eb = a.exec.stats(), b.exec.stats()
+    assert ea["host_uploads"] == ea["wave_dispatches"]
+    assert eb["host_uploads"] >= 3 * eb["wave_dispatches"]
+    assert ea["packed_upload_bytes"] > 0
+    assert eb["packed_upload_bytes"] == 0
+
+
+def test_sharded_packed_bit_identical_to_unpacked(eight_devices):
+    from bevy_ggrs_tpu.parallel import make_lobby_mesh
+
+    # M=6 on D=8: two permanent pad lanes ride the packed buffer too
+    mesh = make_lobby_mesh(len(eight_devices))
+    a, a_sums = _drive_batched(packed=True, m=6, ticks=18, mesh=mesh)
+    b, b_sums = _drive_batched(packed=False, m=6, ticks=18, mesh=mesh)
+    assert a_sums == b_sums
+    ea = a.exec.stats()
+    assert ea["host_uploads"] == ea["wave_dispatches"]
+    assert ea["packed_upload_bytes"] > 0

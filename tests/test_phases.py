@@ -313,6 +313,27 @@ def test_runner_stats_phases_and_compile():
     assert all(v > 0 for v in st["compile_ms"].values())
 
 
+def test_packed_staging_attributed_to_stage_inputs():
+    """The packed single-upload path must keep its host-side staging work
+    (pack rows + synchronous commit) attributed under ``stage_inputs`` —
+    and the totals must still reconcile (attributed + unattributed = wall),
+    so the packing refactor cannot silently open an attribution hole."""
+    app = make_counter_app()
+    runner, mismatches = make_runner(app)
+    for _ in range(12):
+        runner.tick()
+    assert mismatches == []
+    st = runner.stats()
+    assert st["packed"], "driver did not take the packed path"
+    t = st["phases"]
+    assert t["phase_seconds"].get("stage_inputs", 0.0) > 0.0
+    attributed = sum(t["phase_seconds"].values())
+    assert attributed == pytest.approx(t["attributed_seconds"], abs=1e-5)
+    assert t["wall_seconds"] == pytest.approx(
+        t["attributed_seconds"] + t["unattributed_seconds"], abs=1e-5
+    )
+
+
 # ------------------------------------------------------- bench history
 
 
@@ -379,6 +400,44 @@ def test_bench_history_excludes_non_throughput_keys():
     })
     assert set(metrics) == {"value", "canonical_mode_fps",
                             "pipeline_speedup"}
+
+
+def test_bench_history_upload_census_gates_on_increase(tmp_path):
+    """The stage_uploads census metrics are LOWER-is-better: an extra
+    upload per tick (1.0 -> 2.0) must fail the gate even while every
+    throughput metric improves."""
+    bh = _load_bench_history()
+    assert set(bh.floor_metrics({
+        "uploads_per_tick_packed": 1.0, "dispatches_per_tick_packed": 1.0,
+        "megastep_uploads_per_flush": 1.0, "value": 10.0, "spread": 0.1,
+    })) == {"uploads_per_tick_packed", "dispatches_per_tick_packed",
+            "megastep_uploads_per_flush"}
+    _write_record(tmp_path, 1, {"value": 1000.0,
+                                "uploads_per_tick_packed": 1.0,
+                                "platform": "cpu"})
+    _write_record(tmp_path, 2, {"value": 1500.0,
+                                "uploads_per_tick_packed": 2.0,
+                                "platform": "cpu"})
+    assert bh.main(["--dir", str(tmp_path), "--threshold", "0.10"]) == 1
+    # holding the floor passes
+    _write_record(tmp_path, 3, {"value": 1500.0,
+                                "uploads_per_tick_packed": 1.0,
+                                "platform": "cpu"})
+    assert bh.main(["--dir", str(tmp_path), "--threshold", "0.10"]) == 0
+
+
+def test_bench_history_megastep_flatness_is_higher_is_better(tmp_path):
+    """megastep_frames_per_dispatch ~ N when flushes stay fused; a fall
+    (the program splitting into multiple dispatches) is the regression."""
+    bh = _load_bench_history()
+    _write_record(tmp_path, 1, {"megastep_frames_per_dispatch": 8.0,
+                                "platform": "cpu"})
+    _write_record(tmp_path, 2, {"megastep_frames_per_dispatch": 4.0,
+                                "platform": "cpu"})
+    assert bh.main(["--dir", str(tmp_path), "--threshold", "0.10"]) == 1
+    _write_record(tmp_path, 3, {"megastep_frames_per_dispatch": 8.0,
+                                "platform": "cpu"})
+    assert bh.main(["--dir", str(tmp_path), "--threshold", "0.10"]) == 0
 
 
 # ------------------------------------------------------------- lint mirror

@@ -128,7 +128,7 @@ def test_pipeline_default_on_and_counted_in_stats():
 # -- p2p over deterministic channel ------------------------------------------
 
 
-def _channel_pair(pipeline=True, desync=DesyncDetection.on(1)):
+def _channel_pair(pipeline=True, desync=DesyncDetection.on(1), packed=True):
     net = ChannelNetwork(seed=7)
     socks = [net.endpoint(f"p{i}") for i in range(2)]
     runners = []
@@ -149,6 +149,7 @@ def _channel_pair(pipeline=True, desync=DesyncDetection.on(1)):
                 h: box_game.keys_to_input(right=True) for h in hs
             },
             pipeline=pipeline,
+            packed=packed,
         ))
     for _ in range(500):
         net.deliver()
@@ -251,7 +252,25 @@ def test_real_divergence_detected_with_pipelining_on():
 
 
 def test_persistent_staging_buffer_is_reused():
+    # default (packed) path: one persistent int8 buffer carries every upload
     net, runners = _channel_pair(pipeline=True, desync=DesyncDetection.OFF)
+    _interleave(net, runners, 10)
+    buf = runners[0]._stage_packed
+    assert buf is not None
+    _interleave(net, runners, 10)
+    assert runners[0]._stage_packed is buf, (
+        "solo-runner staging must reuse its persistent buffer, not "
+        "reallocate per tick"
+    )
+    assert runners[0]._stage_inputs is None  # unpacked staging never ran
+    for r in runners:
+        r.finish()
+
+
+def test_persistent_staging_buffer_is_reused_unpacked():
+    net, runners = _channel_pair(
+        pipeline=True, desync=DesyncDetection.OFF, packed=False
+    )
     _interleave(net, runners, 10)
     buf = runners[0]._stage_inputs
     assert buf is not None
