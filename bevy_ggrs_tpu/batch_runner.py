@@ -264,7 +264,24 @@ class BatchedRunner:
         if self.pipeline:
             self._rbq.start(init_batch)
         self._world_checksum = [init_batch.ref(b) for b in range(m)]
+        # device-memory accounting (telemetry/devmem.py): the resident
+        # stacked world, the per-lobby snapshot rings (one padded-world
+        # footprint per stored entry) and the staging buffers below all
+        # report under this instance's namespace and die with it
+        import weakref
+
+        from .utils.mem import tree_device_bytes
+
+        self._devmem_tag = telemetry.devmem.scope("batched")
+        weakref.finalize(self, telemetry.devmem.forget_scope, self._devmem_tag)
+        worlds_nbytes = tree_device_bytes(self.worlds)
+        telemetry.devmem.note(self._devmem_tag + "/worlds", worlds_nbytes)
+        row_nbytes = worlds_nbytes // max(m_pad, 1)
         self.rings = [SnapshotRing(depth=max(windows) + 2) for _ in range(m)]
+        for b, ring in enumerate(self.rings):
+            ring.set_accounting(
+                f"{self._devmem_tag}/ring{b}", row_nbytes
+            )
         self.frames = [0] * m  # per-lobby RollbackFrameCount
         self.confirmed = [NULL_FRAME] * m
         self.ticks = 0
@@ -300,6 +317,16 @@ class BatchedRunner:
             app.packed_spec.new_batch_buffer(m_pad, self.k_max)
             if self.packed else None
         )
+        telemetry.devmem.note(
+            self._devmem_tag + "/staging",
+            self._stage_inputs.nbytes + self._stage_status.nbytes
+            + self._stage_starts.nbytes,
+        )
+        if self._stage_packed is not None:
+            telemetry.devmem.note(
+                self._devmem_tag + "/packed_staging",
+                self._stage_packed.nbytes,
+            )
         # stable bound-method refs: snapshot-strategy hooks fused into the
         # batched load/save programs (and the jit-cache keys of
         # fused_load_rows / fused_gather_rows)
@@ -367,8 +394,21 @@ class BatchedRunner:
             self.rings[b].confirm(cf)
         if n_waves:
             # handshake-only ticks (no lobby emitted an op) stay out of the
-            # flight ring — they would evict the interesting entries
-            ph.end_tick(frame=max(self.frames), lobbies=len(self.sessions))
+            # flight ring — they would evict the interesting entries.  The
+            # residency stamp feeds the trace counter track; gated so the
+            # fully-disabled path computes nothing (telemetry/trace.py)
+            if ph.on:
+                ph.end_tick(
+                    frame=max(self.frames), lobbies=len(self.sessions),
+                    device_bytes=telemetry.devmem.total(),
+                    pipeline_depth=(
+                        self._rbq.depth() if self.pipeline else 0
+                    ),
+                )
+            else:
+                ph.end_tick(
+                    frame=max(self.frames), lobbies=len(self.sessions)
+                )
 
     def _collect_ops(self, b: int, s) -> List[_Op]:
         with self._phases.phase("net_poll"):

@@ -84,11 +84,25 @@ class SpeculationCache:
             "packed_upload_bytes",
             "bytes staged through packed single-upload buffers",
         )
+        # device-memory accounting (telemetry/devmem.py): the branch cache
+        # pins whole speculated worlds — exactly the residency the HBM
+        # budget (max_cached_bytes) exists to bound
+        import weakref
+
+        from ..telemetry import devmem
+
+        self._devmem_owner = devmem.scope("speculation") + "/branch_cache"
+        weakref.finalize(self, devmem.forget, self._devmem_owner)
 
     @property
     def cached_bytes(self) -> int:
         """Device bytes currently pinned by cached branch states."""
         return sum(self._entry_bytes.values())
+
+    def _renote(self) -> None:
+        from ..telemetry import devmem
+
+        devmem.note(self._devmem_owner, self.cached_bytes)
 
     def _account(self, start_frame: int, entry: Dict) -> None:
         from ..utils.mem import tree_device_bytes
@@ -96,6 +110,7 @@ class SpeculationCache:
         self._entry_bytes[start_frame] = sum(
             tree_device_bytes(branch) for branch in entry.values()
         )
+        self._renote()
 
     def _stage_packed(self, cands: np.ndarray, start_frame: int,
                       depth: int) -> np.ndarray:
@@ -227,7 +242,9 @@ class SpeculationCache:
 
     def _drop(self, frame: int) -> int:
         del self._cache[frame]
-        return self._entry_bytes.pop(frame, 0)
+        freed = self._entry_bytes.pop(frame, 0)
+        self._renote()
+        return freed
 
     def _trim(self) -> None:
         """Evict the OLDEST start frames past the frame cap and the device-
@@ -256,11 +273,13 @@ class SpeculationCache:
         for s in [s for s in self._cache if frame_gt(s, frame)]:
             del self._cache[s]
             self._entry_bytes.pop(s, None)
+        self._renote()
 
     def clear(self) -> None:
         """Drop every cached branch (and its byte accounting)."""
         self._cache.clear()
         self._entry_bytes.clear()
+        self._renote()
 
 
 def jax_tree_slice(tree, idx):

@@ -245,6 +245,14 @@ class GgrsRunner:
         # netstats.py); attached by set_session for sessions that expose
         # network_stats, polled inside the net_poll phase
         self._netstats = None
+        # device-memory accounting namespace (telemetry/devmem.py): the
+        # ring / megastep-ring / staging owners live under this tag and die
+        # with the runner, so long processes never accumulate stale rows
+        import weakref
+
+        self._devmem_tag = telemetry.devmem.scope("solo")
+        weakref.finalize(self, telemetry.devmem.forget_scope, self._devmem_tag)
+        self._world_nbytes = 0  # one world's device footprint (set_session)
         if session is not None:
             self.set_session(session)
 
@@ -343,6 +351,15 @@ class GgrsRunner:
             # ring must hold a snapshot window frames back even if a session
             # reports rollback_window > max_prediction
             self.ring.set_depth(self._ring_depth(session))
+            # device-memory accounting: ring residency = stored snapshots x
+            # one world's footprint (docs/observability.md "Tracing &
+            # device memory"); shapes are static so compute the unit once
+            from .utils.mem import tree_device_bytes
+
+            self._world_nbytes = tree_device_bytes(self._world)
+            self.ring.set_accounting(
+                self._devmem_tag + "/snapshot_ring", self._world_nbytes
+            )
             # sessions may start at a nonzero frame (wraparound tests, resumed
             # sessions); mirror it so ctx.frame/time agree from tick one
             cur = getattr(session, "current_frame", 0)
@@ -449,8 +466,18 @@ class GgrsRunner:
                 self._drain_inflight()
         if stepped:
             # idle accumulator polls (sub-frame deltas, handshake spins)
-            # don't flood the flight ring with empty entries
-            ph.end_tick(frame=self.frame)
+            # don't flood the flight ring with empty entries.  The counter
+            # stamps (device residency, in-flight readbacks) feed the
+            # Chrome-trace counter tracks (telemetry/trace.py); guarded on
+            # the recording gate so the fully-disabled path computes nothing
+            if ph.on:
+                ph.end_tick(
+                    frame=self.frame,
+                    device_bytes=telemetry.devmem.total(),
+                    pipeline_depth=self._rbq.depth() if self.pipeline else 0,
+                )
+            else:
+                ph.end_tick(frame=self.frame)
 
     @property
     def checksum(self) -> int:
@@ -861,6 +888,10 @@ class GgrsRunner:
             self._stage_status = np.zeros(
                 (self._stage_cap, *row_st.shape), row_st.dtype
             )
+            telemetry.devmem.note(
+                self._devmem_tag + "/staging",
+                self._stage_inputs.nbytes + self._stage_status.nbytes,
+            )
         for i, a in enumerate(adv):
             self._stage_inputs[i] = a.inputs
             self._stage_status[i] = a.status
@@ -886,6 +917,10 @@ class GgrsRunner:
         if self._stage_packed is None or self._packed_cap < kp:
             self._packed_cap = max(kp, self._packed_cap * 2)
             self._stage_packed = spec.new_buffer(self._packed_cap)
+            telemetry.devmem.note(
+                self._devmem_tag + "/packed_staging",
+                self._stage_packed.nbytes,
+            )
         buf = self._stage_packed
         pack_prefix(buf, start_frame, k, has_load, load_slot)
         for i, a in enumerate(adv):
@@ -1195,6 +1230,15 @@ class GgrsRunner:
             self.world, self._ms_slots
         )
         self._dev_frames = {}
+        # device-memory accounting: the on-device ring is a fixed
+        # [slots, ...] stacked world plus the slot->frame vector
+        from .utils.mem import tree_device_bytes
+
+        telemetry.devmem.note(
+            self._devmem_tag + "/megastep_ring",
+            tree_device_bytes(self._ms_ring)
+            + tree_device_bytes(self._ms_ring_frames),
+        )
 
     def _dev_slot(self, frame: int) -> Optional[int]:
         """Device-ring slot currently holding ``frame``, or None when the

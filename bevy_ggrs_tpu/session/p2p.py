@@ -398,6 +398,13 @@ class P2PSession:
                 for h in self.local_handles
             )
             self._local_sent.append((eff, raw))
+            # flow-correlation anchor: a remote peer's rollback blaming
+            # (handle, frame) pairs with this send in the merged Chrome
+            # trace (telemetry/trace.py — one arrow from cause to effect)
+            telemetry.record(
+                "input_send", frame=eff, handles=list(self.local_handles),
+                size=len(raw),
+            )
             for ep in self.endpoints.values():
                 if ep.state == SessionState.RUNNING and not ep.disconnected:
                     ep.send_inputs(self._local_sent)

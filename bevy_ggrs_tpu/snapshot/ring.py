@@ -41,6 +41,27 @@ class SnapshotRing(Generic[T]):
         self._frames: Deque[int] = deque()
         self._snapshots: Deque[T] = deque()
         self._depth = depth
+        # device-memory accounting (telemetry/devmem.py): owner + per-entry
+        # byte estimate set by the driver; None keeps every ring op free
+        self._devmem_owner: Optional[str] = None
+        self._entry_bytes = 0
+
+    def set_accounting(self, owner: Optional[str], entry_bytes: int) -> None:
+        """Register this ring with the device-memory registry: every
+        mutation re-notes ``len(ring) * entry_bytes`` under ``owner``
+        (``entry_bytes`` = one stored world's device footprint — the
+        driver computes it once per session; lazy-slice entries share
+        their stacked buffer, so this is the materialized upper bound).
+        ``owner=None`` turns accounting back off."""
+        self._devmem_owner = owner
+        self._entry_bytes = int(entry_bytes)
+        if owner is not None:
+            self._renote()
+
+    def _renote(self) -> None:
+        from ..telemetry import devmem
+
+        devmem.note(self._devmem_owner, len(self._frames) * self._entry_bytes)
 
     # -- introspection -----------------------------------------------------
 
@@ -63,6 +84,8 @@ class SnapshotRing(Generic[T]):
         while len(self._frames) > self._depth:
             self._frames.pop()
             self._snapshots.pop()
+        if self._devmem_owner is not None:
+            self._renote()
 
     def push(self, frame: int, snapshot: T) -> None:
         """Store ``snapshot`` for ``frame``, evicting stored frames that are
@@ -75,6 +98,8 @@ class SnapshotRing(Generic[T]):
         while len(self._frames) > self._depth:
             self._frames.pop()
             self._snapshots.pop()
+        if self._devmem_owner is not None:
+            self._renote()
 
     def confirm(self, frame: int) -> None:
         """Drop snapshots strictly older than the confirmed frame
@@ -82,6 +107,8 @@ class SnapshotRing(Generic[T]):
         while self._frames and frame_lt(self._frames[-1], frame):
             self._frames.pop()
             self._snapshots.pop()
+        if self._devmem_owner is not None:
+            self._renote()
 
     def rollback(self, frame: int) -> T:
         """Discard entries newer than ``frame``; return its snapshot.
@@ -89,6 +116,8 @@ class SnapshotRing(Generic[T]):
         Raises :class:`MissingSnapshotError` if the frame is absent."""
         while self._frames:
             if self._frames[0] == frame:
+                if self._devmem_owner is not None:
+                    self._renote()
                 return self._snapshots[0]
             self._frames.popleft()
             self._snapshots.popleft()
@@ -113,6 +142,8 @@ class SnapshotRing(Generic[T]):
         """Drop every stored snapshot."""
         self._frames.clear()
         self._snapshots.clear()
+        if self._devmem_owner is not None:
+            self._renote()
 
 
 def rollback_many(

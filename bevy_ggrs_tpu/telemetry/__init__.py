@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 
+from . import devmem  # noqa: F401 (namespace re-export: telemetry.devmem)
 from .flight import (  # noqa: F401 (public re-exports)
     FlightRecorder,
     configure as configure_flight,
@@ -68,6 +69,15 @@ from .timeline import (  # noqa: F401
     span_sink,
     timeline,
 )
+from .trace import (  # noqa: F401
+    chrome_trace,
+    flows,
+    merge_report_traces,
+    merge_traces,
+    trace_from_report,
+    validate_chrome_trace,
+    write_trace,
+)
 
 __all__ = [
     "BoundMetric",
@@ -82,6 +92,8 @@ __all__ = [
     "write_desync_report", "merge_reports", "start_http_exporter",
     "flight_recorder", "configure_flight", "dump_flight_record",
     "NetStatsSampler", "qos_score", "qos_snapshot", "update_qos_gauges",
+    "devmem", "chrome_trace", "write_trace", "validate_chrome_trace",
+    "trace_from_report", "merge_traces", "merge_report_traces", "flows",
 ]
 
 
@@ -107,11 +119,12 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Drop all recorded metrics, timeline events and flight-recorder
-    entries (test isolation)."""
+    """Drop all recorded metrics, timeline events, flight-recorder entries
+    and device-memory accounting rows (test isolation)."""
     registry().reset()
     timeline().clear()
     flight_recorder().clear()
+    devmem.reset()
 
 
 def count(name: str, n: float = 1, help: str = "", **labels) -> None:
@@ -200,6 +213,11 @@ def summary() -> dict:
         "timeline_events": len(timeline()),
         "timeline_events_dropped": timeline().dropped,
         "flight_record_entries": len(flight_recorder()),
+        "flight_record_evictions": flight_recorder().evictions,
+        # live device-memory residency (always-on registry — see
+        # telemetry/devmem.py; owner catalog in docs/observability.md)
+        "device_resident_bytes": devmem.snapshot(),
+        "device_resident_total_bytes": devmem.total(),
     }
 
 

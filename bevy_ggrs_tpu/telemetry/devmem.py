@@ -1,0 +1,151 @@
+"""Device-memory accounting — who owns the bytes resident on the device.
+
+The drivers keep several long-lived device (and pinned-host staging)
+allocations alive between ticks: the snapshot ring, the megastep device
+ring, the packed/unpacked staging buffers, the batched resident worlds and
+the speculation branch cache.  None of them show up in any metric, so "why
+is HBM full" has meant reading allocation sites.  This module is the
+registry that answers it:
+
+- every long-lived allocation site calls :func:`note` with an **owner**
+  string and its current byte count (absolute, not a delta — re-noting
+  after a reallocation or a ring push replaces the old figure);
+- owners are namespaced per driver instance via :func:`scope`
+  (``solo0/snapshot_ring``, ``batched0/worlds``, ...) and garbage-collected
+  with the instance via :func:`forget_scope` (the drivers register a
+  ``weakref.finalize``), so a long bench run never accumulates stale rows;
+- while telemetry is enabled every note also lands on the
+  ``device_resident_bytes{owner}`` gauge (docs/observability.md "Tracing &
+  device memory"); the plain-dict registry itself is ALWAYS on — one dict
+  store per note — so :func:`snapshot` works even when metrics never were;
+- :func:`census` reconciles the registry against ``jax.live_arrays()`` —
+  registered-but-freed or live-but-unregistered bytes are the drift the
+  reconciliation test bounds.
+
+``telemetry.summary()`` carries :func:`snapshot` + :func:`total` as the
+live-residency line, and the Chrome-trace export (:mod:`.trace`) emits
+:func:`total` as a counter track per tick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from . import metrics as _metrics
+
+_BUFFERS: Dict[str, int] = {}
+_SCOPE_COUNTS: Dict[str, int] = {}
+
+_GAUGE_HELP = (
+    "bytes of long-lived device/staging memory per owning allocation site"
+)
+
+# generation-checked gauge-family + label-key cache (the BoundMetric idiom):
+# note() runs inside drivers' per-tick ring/staging updates, so it must not
+# re-pay the family lookup and label-tuple build on every call.
+_gauge_gen = -1
+_gauge = None
+_owner_keys: Dict[str, tuple] = {}
+
+
+def _gauge_key(reg, owner: str):
+    global _gauge_gen, _gauge
+    if _gauge_gen != reg.generation:
+        _gauge = reg.gauge("device_resident_bytes", _GAUGE_HELP)
+        _owner_keys.clear()
+        _gauge_gen = reg.generation
+    key = _owner_keys.get(owner)
+    if key is None:
+        key = _owner_keys[owner] = _metrics._label_key({"owner": owner})
+    return _gauge, key
+
+
+def scope(prefix: str) -> str:
+    """A unique owner namespace for one driver instance (``solo0``,
+    ``solo1``, ...).  Pair with ``weakref.finalize(self, forget_scope, tag)``
+    so the rows die with the instance."""
+    n = _SCOPE_COUNTS.get(prefix, 0)
+    _SCOPE_COUNTS[prefix] = n + 1
+    return f"{prefix}{n}"
+
+
+def note(owner: str, nbytes: int) -> None:
+    """Record ``owner``'s current resident byte count (absolute).
+
+    Always updates the registry dict; mirrors to the
+    ``device_resident_bytes`` gauge only while telemetry is enabled, so a
+    note from a hot path costs one dict store when telemetry is off."""
+    nbytes = int(nbytes)
+    _BUFFERS[owner] = nbytes
+    reg = _metrics.registry()
+    if reg.enabled:
+        gauge, key = _gauge_key(reg, owner)
+        gauge.set_key(key, nbytes)
+
+
+def forget(owner: str) -> None:
+    """Drop one owner's row (its buffers were freed); zeroes the gauge."""
+    _BUFFERS.pop(owner, None)
+    reg = _metrics.registry()
+    if reg.enabled:
+        gauge, key = _gauge_key(reg, owner)
+        gauge.set_key(key, 0)
+
+
+def forget_scope(tag: str) -> None:
+    """Drop every owner under ``tag/`` — the driver-finalizer cleanup."""
+    for owner in [o for o in _BUFFERS if o == tag or o.startswith(tag + "/")]:
+        forget(owner)
+
+
+def snapshot() -> Dict[str, int]:
+    """``{owner: bytes}`` — the current registry contents."""
+    return dict(_BUFFERS)
+
+
+def total() -> int:
+    """Sum over all owners (the trace export's counter-track value)."""
+    return sum(_BUFFERS.values())
+
+
+def reset() -> None:
+    """Drop every row and scope counter (test isolation; wired into
+    ``telemetry.reset()``)."""
+    _BUFFERS.clear()
+    _SCOPE_COUNTS.clear()
+
+
+def census() -> dict:
+    """Reconcile the registry against ``jax.live_arrays()``.
+
+    Returns ``{"registered_bytes", "live_bytes", "live_arrays",
+    "unregistered_bytes", "owners"}``.  ``live_bytes`` counts every live
+    jax array in the process — including transients in flight — so
+    ``unregistered_bytes`` (live minus registered, floored at 0) is an
+    upper bound on what the owners table is missing, not an exact leak.
+    ``live_bytes`` is None when the running jax has no ``live_arrays``."""
+    live_bytes = None
+    n_live = None
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        n_live = len(arrays)
+        live_bytes = 0
+        for a in arrays:
+            try:
+                live_bytes += int(a.size) * a.dtype.itemsize
+            except (AttributeError, TypeError):
+                continue
+    except (ImportError, AttributeError, RuntimeError):
+        pass
+    registered = total()
+    return {
+        "registered_bytes": registered,
+        "live_bytes": live_bytes,
+        "live_arrays": n_live,
+        "unregistered_bytes": (
+            max(live_bytes - registered, 0) if live_bytes is not None else None
+        ),
+        "owners": snapshot(),
+    }

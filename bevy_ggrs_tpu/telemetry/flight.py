@@ -47,6 +47,7 @@ class FlightRecorder:
         self.enabled = bool(enabled)
         self._ring: Deque[dict] = deque(maxlen=int(maxlen))
         self._seq = 0
+        self.evictions = 0  # entries pushed out past the ring bound
 
     @property
     def maxlen(self) -> int:
@@ -54,20 +55,29 @@ class FlightRecorder:
         return self._ring.maxlen or 0
 
     def set_maxlen(self, maxlen: int) -> None:
-        """Resize the ring, keeping the newest entries that still fit."""
+        """Resize the ring, keeping the newest entries that still fit
+        (entries shed by a shrink count as :attr:`evictions`)."""
         maxlen = int(maxlen)
         if maxlen != self._ring.maxlen:
+            self.evictions += max(len(self._ring) - maxlen, 0)
             self._ring = deque(self._ring, maxlen=maxlen)
 
     def record(self, kind: str, **fields) -> None:
         """Append one event (``kind`` ∈ ``tick`` / ``rollback`` /
-        ``compile`` / ``forced_readback`` / ...); no-op when disabled."""
+        ``compile`` / ``forced_readback`` / ...); no-op when disabled.
+
+        Appending past the ring bound evicts the oldest entry and counts it
+        in :attr:`evictions` (surfaced by ``telemetry.summary()`` and trace
+        metadata) — a bounded black box must say what it forgot."""
         if not self.enabled:
             return
         self._seq += 1
         ev = {"seq": self._seq, "t": time.perf_counter(), "kind": kind}
         ev.update(fields)
-        self._ring.append(ev)
+        ring = self._ring
+        if len(ring) == ring.maxlen:
+            self.evictions += 1
+        ring.append(ev)
 
     def snapshot(self, kind: Optional[str] = None) -> List[dict]:
         """The ring's entries in order (optionally one ``kind`` only)."""
@@ -77,8 +87,10 @@ class FlightRecorder:
         return evs
 
     def clear(self) -> None:
-        """Drop every entry (the sequence counter keeps counting)."""
+        """Drop every entry and reset :attr:`evictions` (the sequence
+        counter keeps counting)."""
         self._ring.clear()
+        self.evictions = 0
 
     def __len__(self) -> int:
         return len(self._ring)

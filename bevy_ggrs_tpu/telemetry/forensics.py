@@ -135,6 +135,15 @@ def write_desync_report(
         "flight_record": _flight.flight_recorder().snapshot(),
         "metrics": _metrics.registry().snapshot(),
     }
+    # Perfetto-loadable excerpt of the same window: extract with jq
+    # '.trace_slice' or feed both peers' reports to replay_tool.py
+    # merge-reports --trace-out for the cross-peer flow-arrow view
+    from .trace import chrome_trace
+
+    report["trace_slice"] = chrome_trace(
+        report["timeline_tail"], report["flight_record"],
+        metadata={"report_kind": kind},
+    )
     with open(path, "w") as f:
         json.dump(report, f, indent=2, default=repr)
     reg_ = _metrics.registry()

@@ -88,6 +88,14 @@ class Gauge(_Metric):
         with self._reg._lock:
             self._series[_label_key(labels)] = v
 
+    def set_key(self, key: LabelKey, v: float) -> None:
+        """Set by precomputed label key — hot-path variant (the
+        ``Histogram.observe_key`` analog) for callers that cache the key."""
+        if not self._reg.enabled:
+            return
+        with self._reg._lock:
+            self._series[key] = v
+
     def inc(self, n: float = 1, **labels) -> None:
         """Add ``n`` to the gauge (down with negative ``n``)."""
         if not self._reg.enabled:

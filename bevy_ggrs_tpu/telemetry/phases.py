@@ -171,6 +171,14 @@ class PhaseSet:
         self._keys = {}
         self._owner_key = ()
 
+    @property
+    def on(self) -> bool:
+        """Whether this tick is being recorded (set by :meth:`begin_tick`:
+        flight recorder OR telemetry enabled).  Drivers gate optional
+        ``end_tick(**extra)`` computations on it so the fully-disabled tick
+        path stays one boolean check."""
+        return self._on
+
     def phase(self, name: str) -> _Phase:
         """The catalog timer for ``name`` (KeyError on a non-catalog name —
         a typo here would silently grow ``unattributed_ms``)."""

@@ -13,7 +13,11 @@ The handler renders :meth:`MetricsRegistry.render_prometheus` per scrape —
 no caching, no extra thread work between scrapes.  ``GET /qos`` serves the
 JSON lobby-health snapshot from :mod:`.qos` (schema documented in
 ``docs/observability.md``), refreshing the ``lobby_qos_score`` gauges as a
-side effect so the next ``/metrics`` scrape carries them too."""
+side effect so the next ``/metrics`` scrape carries them too.
+``GET /trace`` serves a bounded Chrome-trace JSON snapshot of the process
+timeline + flight recorder (:mod:`.trace`) — save it and drop it straight
+into ui.perfetto.dev (``?n=`` caps the per-stream event count, default
+``TRACE_DEFAULT_EVENTS``)."""
 
 from __future__ import annotations
 
@@ -26,6 +30,12 @@ from .metrics import MetricsRegistry, registry as _default_registry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 QOS_CONTENT_TYPE = "application/json; charset=utf-8"
+
+# /trace response bound: events taken from the tail of EACH source stream
+# (timeline + flight ring); a scraper polling a busy server must never pull
+# an unbounded 64Ki-event body
+TRACE_DEFAULT_EVENTS = 2048
+TRACE_MAX_EVENTS = 16384
 
 
 class MetricsExporter:
@@ -40,11 +50,26 @@ class MetricsExporter:
 
             def do_GET(self):  # noqa: N802 (stdlib naming)
                 """Serve exposition text (``/metrics``) or QoS JSON (``/qos``)."""
-                path = self.path.split("?")[0]
+                path, _, query = self.path.partition("?")
                 if path == "/qos":
                     from .qos import update_qos_gauges
 
                     body = json.dumps(update_qos_gauges(reg)).encode("utf-8")
+                    ctype = QOS_CONTENT_TYPE
+                elif path == "/trace":
+                    from .trace import chrome_trace
+
+                    n = TRACE_DEFAULT_EVENTS
+                    for part in query.split("&"):
+                        if part.startswith("n="):
+                            try:
+                                n = int(part[2:])
+                            except ValueError:
+                                pass
+                    n = max(1, min(n, TRACE_MAX_EVENTS))
+                    body = json.dumps(
+                        chrome_trace(max_events=n), default=repr
+                    ).encode("utf-8")
                     ctype = QOS_CONTENT_TYPE
                 elif path in ("/metrics", "/"):
                     body = reg.render_prometheus().encode("utf-8")

@@ -58,6 +58,19 @@ class Timeline:
             ).inc()
         events.append(ev)
 
+    @property
+    def maxlen(self) -> int:
+        """The ring bound (events kept)."""
+        return self._events.maxlen or 0
+
+    def set_maxlen(self, maxlen: int) -> None:
+        """Resize the ring, keeping the newest events that still fit
+        (events shed by a shrink count as :attr:`dropped`)."""
+        maxlen = int(maxlen)
+        if maxlen != self._events.maxlen:
+            self.dropped += max(len(self._events) - maxlen, 0)
+            self._events = deque(self._events, maxlen=maxlen)
+
     def events(self, kind: Optional[str] = None, **field_filter) -> List[dict]:
         """Recorded events in order, optionally filtered by kind/fields."""
         out = []

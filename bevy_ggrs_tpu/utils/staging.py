@@ -21,6 +21,12 @@ import jax
 
 def commit(buf, sharding=None):
     """Upload ``buf`` and wait for the copy; returns the device array."""
+    from ..telemetry import devmem
+
+    # device-memory accounting: the device copy of the most recent staging
+    # commit stays resident until the dispatch consumes it (one dict store
+    # — see telemetry/devmem.py's cost posture)
+    devmem.note("staging/last_commit", getattr(buf, "nbytes", 0))
     x = (
         jax.device_put(buf, sharding)
         if sharding is not None

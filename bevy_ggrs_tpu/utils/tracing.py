@@ -3,9 +3,17 @@
 The reference wraps request handling and each schedule in tracing spans
 ("ggrs"/"HandleRequests", "SaveWorld", "LoadWorld", "AdvanceWorld" —
 /root/reference/src/schedule_systems.rs:171,224-253) and relies on the host
-engine's tracing backend.  Here the equivalent is a process-local ring of
-(name, t_start, t_end) events plus stdlib logging; the JAX profiler covers
-the device side (``jax.profiler.trace``).
+engine's tracing backend.  Here ``span`` feeds two sinks: stdlib logging
+(always) and the telemetry timeline when enabled (``set_span_sink`` — the
+timeline then carries the spans into ``telemetry.chrome_trace()`` as
+Perfetto slices).  The JAX profiler covers the device side
+(``jax.profiler.trace``).
+
+The module-local ``(name, t0, t1)`` ring this module once kept is gone:
+phase attribution moved to :mod:`..telemetry.phases` (exact per-phase
+timers with flight-recorder persistence) and span *export* to
+:mod:`..telemetry.trace`.  ``get_trace_events`` / ``clear_trace_events``
+remain as deprecated no-op shims so old callers keep importing.
 """
 
 from __future__ import annotations
@@ -13,12 +21,10 @@ from __future__ import annotations
 import contextlib
 import logging
 import time
-from collections import deque
-from typing import Callable, Deque, Optional, Tuple
+from typing import Callable, Optional
 
 logger = logging.getLogger("bevy_ggrs_tpu")
 
-_EVENTS: Deque[Tuple[str, float, float]] = deque(maxlen=4096)
 _ENABLED = True
 _SPAN_SINK: Optional[Callable[[str, float, float], None]] = None
 
@@ -50,7 +56,6 @@ def span(name: str):
         yield
     finally:
         t1 = time.perf_counter()
-        _EVENTS.append((name, t0, t1))
         if _SPAN_SINK is not None:
             _SPAN_SINK(name, t0, t1)
         logger.debug("span %s: %.3f ms", name, (t1 - t0) * 1e3)
@@ -62,10 +67,11 @@ def trace_log(msg: str, *args) -> None:
 
 
 def get_trace_events():
-    """Return the recorded (name, t_start, t_end) span events."""
-    return list(_EVENTS)
+    """Deprecated: the module-local span ring is gone.  Always returns
+    ``[]``.  Use ``telemetry.flight_recorder().snapshot("tick")`` for phase
+    attribution or ``telemetry.chrome_trace()`` for span export."""
+    return []
 
 
 def clear_trace_events() -> None:
-    """Reset the recorded span buffer."""
-    _EVENTS.clear()
+    """Deprecated no-op (see :func:`get_trace_events`)."""

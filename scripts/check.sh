@@ -32,7 +32,7 @@ g.dryrun_multichip(8)
 print("graft ok")
 EOF
 
-echo "== bench smoke (batched + sharded + netstats stages, gates armed) =="
+echo "== bench smoke (batched + sharded + netstats + trace stages, gates armed) =="
 # the sharded stage runs under forced 8-virtual-device CPU and hard-fails
 # unless per-device dispatches per tick are flat across lobby counts; the
 # netstats stage hard-fails unless every rollback carries a blamed handle

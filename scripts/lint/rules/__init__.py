@@ -8,7 +8,7 @@ catalog all key on them):
 - ``BGT00x`` hygiene: unused imports, duplicate defs, syntax, bad ignores
 - ``BGT01x`` hot-loop purity (intra + interprocedural + allowlist meta)
 - ``BGT02x`` tick-phase timer discipline
-- ``BGT03x`` metric-name <-> docs-catalog cross-check
+- ``BGT03x`` metric-name and trace-kind <-> docs-catalog cross-checks
 - ``BGT04x`` determinism hazards in step/model/session code
 - ``BGT05x`` rule-id <-> docs-catalog cross-check
 """
@@ -17,5 +17,6 @@ from . import imports  # noqa: F401
 from . import purity  # noqa: F401
 from . import phases  # noqa: F401
 from . import metrics  # noqa: F401
+from . import trace_kinds  # noqa: F401
 from . import determinism  # noqa: F401
 from . import docs  # noqa: F401

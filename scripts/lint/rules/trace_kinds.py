@@ -1,0 +1,112 @@
+"""Trace-event-kind <-> docs-catalog cross-check (BGT032/BGT033).
+
+Every trace event ``kind`` the package emits with a literal first argument
+to a ``.record("...")`` call (the timeline's ``telemetry.record`` and the
+flight recorder's ``fr.record`` share the signature) must appear in a
+``| kind | ... |`` table of docs/observability.md ("Tracing & device
+memory"), and every kind the catalog lists must still be emitted somewhere
+— both directions, mirroring the metric catalog check (BGT030/BGT031).
+The Chrome-trace exporter (telemetry/trace.py) routes events by kind, so
+an uncataloged kind is one Perfetto consumers cannot interpret and a stale
+row documents an instant that will never appear.
+
+Unlike BGT030 (which reports against the docs file), the forward direction
+here is reported AT THE EMISSION LINE — the fix is usually a docs row, but
+the witness is the ``.record`` call, and a suppression belongs there when
+a kind is deliberately private.  Tests are excluded (they record throwaway
+kinds on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Tuple
+
+from ..core import Context, Finding, lint_pass, rule
+
+rule(
+    "BGT032", "undocumented-trace-kind",
+    summary="an emitted trace event kind has no docs/observability.md row",
+)
+rule(
+    "BGT033", "stale-trace-kind-doc",
+    summary="a documented trace event kind is never emitted in code",
+)
+
+_KIND_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def collect_trace_kinds(tree: ast.AST) -> List[Tuple[str, int]]:
+    """``(kind, lineno)`` for every ``.record("literal", ...)`` call."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record" and node.args):
+            continue
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+                and _KIND_RE.match(a0.value):
+            out.append((a0.value, node.lineno))
+    return out
+
+
+def docs_trace_kinds(md_text: str) -> set:
+    """Backticked names in the first column of every ``| kind | ... |``
+    table in the docs catalog (same parse as the metric tables, keyed on
+    the ``kind`` header cell)."""
+    names = set()
+    in_table = False
+    for line in md_text.splitlines():
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "kind":
+            in_table = True
+            continue
+        if in_table and not set(cells[0]) <= set("-: "):
+            names.update(re.findall(r"`([a-z][a-z0-9_]+)`", cells[0]))
+    return names
+
+
+@lint_pass
+def trace_kinds_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    if not cfg.project_checks:
+        return []
+    docs_path = ctx.root / cfg.metric_docs
+    if not docs_path.exists():
+        # BGT031 already reports the missing catalog file
+        return []
+    doc_kinds = docs_trace_kinds(docs_path.read_text())
+    out: List[Finding] = []
+    emitted = set()
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        for kind, lineno in collect_trace_kinds(sf.tree):
+            emitted.add(kind)
+            if kind not in doc_kinds:
+                out.append(Finding(
+                    "BGT032", sf.rel, lineno,
+                    f"trace event kind {kind!r} is emitted here but missing "
+                    "from the docs catalog (add a `| kind | payload | "
+                    "meaning |` row to docs/observability.md)",
+                ))
+    # the stale-row direction needs the FULL emission corpus — same guard
+    # as BGT031: the package __init__ in the corpus is the full-run proxy
+    full_corpus = ctx.by_suffix(cfg.package_dir + "/__init__.py") is not None
+    if full_corpus:
+        for kind in sorted(doc_kinds - emitted):
+            out.append(Finding(
+                "BGT033", cfg.metric_docs, 0,
+                f"trace event kind {kind!r} is documented in the catalog "
+                "but never emitted in code (stale row — remove or fix the "
+                "name)",
+            ))
+    return out

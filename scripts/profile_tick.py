@@ -7,12 +7,14 @@
 Runs warmup ticks (compiles outside the capture), then records `--ticks`
 ticks under ``jax.profiler.trace``; view the trace with TensorBoard/XProf.
 Alongside the device trace it prints a host-side wall-time split per
-runner-tick from the driver's span ring (utils/tracing.py): poll, session
-step (SyncTest checksum comparison lives here), request handling with its
-dispatch sub-phases, and unattributed host time — so host-bound vs
-device-bound is obvious at a glance.  This is the tool that pins whether a
-slow driver is paying link round-trips (docs/tpu_notes.md §3b) or real
-compute."""
+runner-tick from the drivers' phase timers (telemetry/phases.py): network
+poll, session step (SyncTest checksum comparison lives here), input
+staging, wave dispatch, readback harvest, rollback load, store/save, and
+the unattributed residual — so host-bound vs device-bound is obvious at a
+glance.  ``--trace-out`` additionally writes the profiled window as a
+Chrome-trace JSON (telemetry/trace.py) loadable in ui.perfetto.dev.  This
+is the tool that pins whether a slow driver is paying link round-trips
+(docs/tpu_notes.md §3b) or real compute."""
 
 import argparse
 import sys
@@ -25,11 +27,6 @@ from bevy_ggrs_tpu.utils.platform import apply_platform_env
 apply_platform_env()
 
 import numpy as np
-
-# spans nested inside HandleRequests (reported indented; excluded from the
-# top-level sum so nothing is double-counted)
-_SUB_SPANS = ("LoadWorld", "AdvanceWorld", "SaveWorld")
-_TOP_SPANS = ("PollRemoteClients", "SessionAdvanceFrame", "HandleRequests")
 
 
 def build_runner(mode: str, entities: int, check_distance: int):
@@ -72,6 +69,19 @@ def build_runner(mode: str, entities: int, check_distance: int):
     return runners, net.deliver
 
 
+def _phase_totals(runners):
+    """Sum the runners' cumulative PhaseSet totals (scripts-side copy so a
+    delta over the profiled window survives warmup accumulation)."""
+    agg = {"wall": 0.0, "unattributed": 0.0, "phases": {}}
+    for r in runners:
+        t = r.stats()["phases"]
+        agg["wall"] += t["wall_seconds"]
+        agg["unattributed"] += t["unattributed_seconds"]
+        for name, s in t["phase_seconds"].items():
+            agg["phases"][name] = agg["phases"].get(name, 0.0) + s
+    return agg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("synctest", "p2p"), default="synctest")
@@ -83,6 +93,9 @@ def main():
     ap.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="enable telemetry and write the profiled ticks' "
                          "timeline (spans, rollbacks, dispatches) as JSONL")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="enable telemetry and write the profiled ticks as "
+                         "Chrome-trace JSON (load in ui.perfetto.dev)")
     ap.add_argument("--phase-breakdown", action="store_true",
                     help="print per-phase p50/p95/p99 latency over the "
                          "profiled window (exact values from the flight "
@@ -91,11 +104,9 @@ def main():
 
     import jax
 
-    from bevy_ggrs_tpu.utils.tracing import clear_trace_events, get_trace_events
+    from bevy_ggrs_tpu import telemetry
 
-    if args.telemetry_out:
-        from bevy_ggrs_tpu import telemetry
-
+    if args.telemetry_out or args.trace_out:
         telemetry.enable()
 
     runners, deliver = build_runner(args.mode, args.entities,
@@ -106,23 +117,22 @@ def main():
         for r in runners:
             r.tick()
 
-    if args.telemetry_out:
+    if args.telemetry_out or args.trace_out:
         telemetry.reset()  # drop warmup events: export the profiled window only
-    if args.phase_breakdown:
-        from bevy_ggrs_tpu import telemetry as _tel
-
-        fr = _tel.flight_recorder()
-        # the ring must hold the whole profiled window for exact percentiles
+    fr = telemetry.flight_recorder()
+    if args.phase_breakdown or args.trace_out:
+        # the ring must hold the whole profiled window (exact percentiles /
+        # one trace slice per tick)
         fr.set_maxlen(max(fr.maxlen, args.ticks * len(runners) + 16))
         fr.clear()
-    clear_trace_events()
+    base = _phase_totals(runners)
     t0 = time.perf_counter()
     with runners[0].profile(args.logdir):
         for _ in range(args.ticks):
             deliver()
             for r in runners:
                 r.tick()
-        # device drain: on accelerators the per-span numbers above measure
+        # device drain: on accelerators the per-phase numbers above measure
         # async SUBMISSION only — queued device compute is paid here
         t_drain = time.perf_counter()
         for r in runners:
@@ -131,38 +141,31 @@ def main():
     wall = time.perf_counter() - t0
 
     runner_ticks = args.ticks * len(runners)
-    per_span: dict = {}
-    for name, ts, te in get_trace_events():
-        per_span[name] = per_span.get(name, 0.0) + (te - ts)
+    cur = _phase_totals(runners)
     print(f"platform: {jax.devices()[0].platform}")
     print(f"{args.ticks} ticks x {len(runners)} runner(s) in {wall:.3f}s -> "
           f"{args.ticks / wall:.1f} ticks/s "
           f"({runner_ticks / wall:.1f} runner-ticks/s)")
-    top_total = 0.0
-    for name in _TOP_SPANS:
-        if name not in per_span:
+    attributed = 0.0
+    for name in telemetry.PHASES:
+        total = cur["phases"].get(name, 0.0) - base["phases"].get(name, 0.0)
+        if total <= 0.0:
             continue
-        total = per_span[name]
-        top_total += total
+        attributed += total
         print(f"  {name:20s} {total * 1e3 / runner_ticks:8.3f} ms/runner-tick")
-        if name == "HandleRequests":
-            for sub in _SUB_SPANS:
-                if sub in per_span:
-                    print(f"    {sub:18s} "
-                          f"{per_span[sub] * 1e3 / runner_ticks:8.3f} "
-                          f"ms/runner-tick")
+    unattr = cur["unattributed"] - base["unattributed"]
+    print(f"  {'(unattributed host)':20s} "
+          f"{unattr * 1e3 / runner_ticks:8.3f} ms/runner-tick")
     print(f"  {'(device drain)':20s} "
           f"{drain * 1e3 / runner_ticks:8.3f} ms/runner-tick")
-    print(f"  {'(unattributed host)':20s} "
-          f"{(wall - top_total - drain) * 1e3 / runner_ticks:8.3f} "
-          f"ms/runner-tick  (includes blocking waits inside spans' callees "
-          f"on CPU)")
+    untimed = wall - attributed - unattr - drain
+    print(f"  {'(outside ticks)':20s} "
+          f"{untimed * 1e3 / runner_ticks:8.3f} ms/runner-tick  "
+          f"(deliver/profiler overhead between ticks)")
     if args.phase_breakdown:
-        from bevy_ggrs_tpu import telemetry as _tel
-
         print("per-phase latency over the profiled window (ms/tick, exact):")
-        print(_tel.format_phase_table(
-            _tel.phase_breakdown(_tel.flight_recorder().snapshot("tick"))
+        print(telemetry.format_phase_table(
+            telemetry.phase_breakdown(fr.snapshot("tick"))
         ))
     # upload census: packed staging cost shows under stage_inputs above;
     # this is the denominator that says whether it bought the single-upload
@@ -181,6 +184,10 @@ def main():
     if args.telemetry_out:
         n = telemetry.export_jsonl(args.telemetry_out)
         print(f"telemetry timeline: {n} events -> {args.telemetry_out}")
+    if args.trace_out:
+        n = telemetry.write_trace(args.trace_out)
+        print(f"chrome trace: {n} events -> {args.trace_out} "
+              f"(load in ui.perfetto.dev)")
 
 
 if __name__ == "__main__":

@@ -16,6 +16,7 @@ frame with both sides' rollback and phase context — run it FIRST, before
 any re-simulation (docs/debugging-desyncs.md §0)."""
 
 import argparse
+import json
 import sys
 
 sys.path.insert(0, ".")
@@ -52,7 +53,7 @@ def cmd_checksums(args):
 
     from bevy_ggrs_tpu import telemetry
 
-    if args.telemetry_out:
+    if args.telemetry_out or args.trace_out:
         telemetry.enable()
     rec = load(args.recording)
     app = getattr(models, args.model).make_app(num_players=rec.num_players)
@@ -80,6 +81,10 @@ def cmd_checksums(args):
     if args.telemetry_out:
         n = telemetry.export_jsonl(args.telemetry_out)
         print(f"telemetry timeline: {n} events -> {args.telemetry_out}")
+    if args.trace_out:
+        n = telemetry.write_trace(args.trace_out)
+        print(f"chrome trace: {n} events -> {args.trace_out} "
+              f"(load in ui.perfetto.dev)")
 
 
 def cmd_diff(args):
@@ -103,6 +108,24 @@ def cmd_merge_reports(args):
 
     m = merge_reports(args.a, args.b)
     first = m["first_divergent_frame"]
+    as_json = getattr(args, "json", False)
+    if getattr(args, "trace_out", None):
+        from bevy_ggrs_tpu.telemetry import merge_report_traces
+
+        with open(args.a) as f:
+            ra = json.load(f)
+        with open(args.b) as f:
+            rb = json.load(f)
+        merged = merge_report_traces(ra, rb)
+        with open(args.trace_out, "w") as f:
+            json.dump(merged, f, default=repr)
+        n = len(merged["traceEvents"])
+        print(f"merged chrome trace: {n} events -> {args.trace_out} "
+              f"(cross-peer flow arrows; load in ui.perfetto.dev)",
+              file=sys.stderr if as_json else sys.stdout)
+    if as_json:
+        print(json.dumps(m, indent=2, default=repr))
+        return 1 if first is not None else 0
     print(f"a: {m['a']}")
     print(f"b: {m['b']}")
     print(f"overlapping checksummed frames: {m['common_frames']}")
@@ -162,6 +185,9 @@ def main():
                    help="print per-phase p50/p95/p99 latency over the "
                         "replay (exact values from the flight recorder; "
                         "needs no telemetry)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable telemetry and write the replay as Chrome-"
+                        "trace JSON (load in ui.perfetto.dev)")
     p = sub.add_parser("diff")
     p.add_argument("a")
     p.add_argument("b")
@@ -171,6 +197,16 @@ def main():
     )
     p.add_argument("a")
     p.add_argument("b")
+    p.add_argument("--json", action="store_true",
+                   help="emit the merge result (first_divergent_frame, "
+                        "component_diff, rollbacks, tick context) as JSON "
+                        "on stdout instead of the text summary; exit codes "
+                        "unchanged (1 on divergence)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write both reports' embedded trace slices as ONE "
+                        "clock-aligned Chrome trace with cross-peer flow "
+                        "arrows from the blamed peer's input send to the "
+                        "victim's rollback (load in ui.perfetto.dev)")
     args = ap.parse_args()
     rc = {
         "info": cmd_info,

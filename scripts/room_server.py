@@ -7,8 +7,10 @@ cannot reach each other directly.
 
 With ``--metrics-port`` the process also serves the telemetry registry as a
 Prometheus text endpoint (``GET /metrics``) plus the lobby QoS snapshot as
-JSON (``GET /qos`` — see docs/observability.md "Network & QoS"); the
-``lobby_qos_score`` gauges are refreshed in the 5 s reporting loop:
+JSON (``GET /qos`` — see docs/observability.md "Network & QoS") and a
+bounded Chrome-trace export (``GET /trace`` — docs/observability.md
+"Tracing & device memory"); the ``lobby_qos_score`` gauges are refreshed
+in the 5 s reporting loop:
 
     python scripts/room_server.py --port 3536 --metrics-port 9464
 """
@@ -50,6 +52,12 @@ def main() -> None:
         )
         print(
             f"qos on http://{args.metrics_host}:{exporter.port}/qos",
+            flush=True,
+        )
+        print(
+            f"trace on http://{args.metrics_host}:{exporter.port}/trace"
+            f"  (Chrome-trace JSON, ?n= caps events; load in"
+            f" ui.perfetto.dev)",
             flush=True,
         )
     server = RoomServer(port=args.port, host=args.host,

@@ -1,0 +1,9 @@
+"""BGT032 clean: only catalogued kinds (docs/observability.md "Tracing &
+device memory" lists ``rollback``), plus non-literal and non-record calls
+the collector must ignore."""
+
+
+def fine(telemetry, recorder, kind):
+    telemetry.record("rollback", to_frame=3, handle=1)
+    telemetry.record(kind, x=1)  # dynamic kind: not collectable
+    recorder.append("zzz_private_event")  # not a .record call

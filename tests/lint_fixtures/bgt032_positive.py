@@ -1,0 +1,6 @@
+"""BGT032 true positive: emits a trace kind the docs catalog does not
+list (the fixture run points at the real docs/observability.md)."""
+
+
+def leak(telemetry):
+    telemetry.record("zzz_private_event", frame=1)

@@ -1,0 +1,7 @@
+"""BGT032 suppressed: the same uncataloged kind, waived at the emission
+site with a reason."""
+
+
+def leak(telemetry):
+    # bgt: ignore[BGT032]: scratch event for a local repro session
+    telemetry.record("zzz_private_event", frame=1)

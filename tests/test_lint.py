@@ -38,6 +38,10 @@ from scripts.lint.rules.metrics import (  # noqa: E402
 )
 from scripts.lint.rules.phases import extract_phase_catalog  # noqa: E402
 from scripts.lint.rules.purity import check_purity  # noqa: E402
+from scripts.lint.rules.trace_kinds import (  # noqa: E402
+    collect_trace_kinds,
+    docs_trace_kinds,
+)
 
 FIXTURES = ROOT / "tests" / "lint_fixtures"
 
@@ -357,6 +361,82 @@ def test_bgt030_and_bgt031_on_synthetic_tree(tmp_path):
     assert len(b30) == 1 and "undocumented_total" in b30[0].message
     b31 = only(findings, "BGT031")
     assert len(b31) == 1 and "ghost_metric" in b31[0].message
+
+
+def test_trace_kind_collection_and_docs_parse():
+    import ast
+
+    tree = ast.parse(
+        "telemetry.record('rollback', to_frame=3)\n"
+        "fr.record('tick', frame=1)\n"
+        "telemetry.record(kind, x=1)\n"       # dynamic: not collectable
+        "recorder.append('not_a_record')\n"   # not a .record call
+        "telemetry.record('Not_A_Kind')\n"    # fails the kind regex
+    )
+    assert collect_trace_kinds(tree) == [("rollback", 1), ("tick", 2)]
+    md = (
+        "| kind | source | meaning |\n"
+        "|------|--------|---------|\n"
+        "| `rollback` | runner | blamed rollback |\n"
+        "\nprose mentioning `not_in_a_table`\n"
+        "| metric | labels | meaning |\n"
+        "|--------|--------|---------|\n"
+        "| `ticks_total` | - | a METRIC table, not a kind table |\n"
+    )
+    assert docs_trace_kinds(md) == {"rollback"}
+
+
+BGT032_CFG = dict(purity_allow={}, project_checks=True,
+                  phases_module="no/such/phases.py")
+
+
+def test_bgt032_fixture_triple():
+    """The fixture triple runs against the REAL docs catalog (fixtures are
+    not tests to this pass), so the positive's private kind fires and the
+    clean fixture's catalogued ``rollback`` does not."""
+    pos = only(lint_paths([FIXTURES / "bgt032_positive.py"], **BGT032_CFG),
+               "BGT032")
+    assert len(pos) == 1 and not pos[0].suppressed
+    assert "zzz_private_event" in pos[0].message
+    sup = only(lint_paths([FIXTURES / "bgt032_suppressed.py"], **BGT032_CFG),
+               "BGT032")
+    assert len(sup) == 1 and sup[0].suppressed and sup[0].suppress_reason
+    assert only(lint_paths([FIXTURES / "bgt032_clean.py"], **BGT032_CFG),
+                "BGT032") == []
+
+
+def test_bgt033_skipped_on_partial_corpus():
+    findings = lint_paths([FIXTURES / "bgt001_clean.py"],
+                          purity_allow={}, project_checks=True)
+    assert only(findings, "BGT033") == []
+
+
+def test_bgt032_and_bgt033_on_synthetic_tree(tmp_path):
+    """Both directions against a synthetic root with a complete corpus."""
+    pkg = tmp_path / "bevy_ggrs_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "def emit(telemetry):\n"
+        "    telemetry.record('undocumented_kind', frame=1)\n"
+        "    telemetry.record('documented_kind', frame=2)\n"
+    )
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| kind | source | meaning |\n"
+        "|------|--------|---------|\n"
+        "| `documented_kind` | pkg | fine |\n"
+        "| `ghost_kind` | nowhere | stale |\n"
+    )
+    cfg = Config(purity_allow={}, project_checks=True,
+                 phases_module="no/such/phases.py")
+    findings, _files = run([str(pkg / "__init__.py")], root=tmp_path,
+                           config=cfg)
+    b32 = only(findings, "BGT032")
+    assert len(b32) == 1 and "undocumented_kind" in b32[0].message
+    assert b32[0].line == 2  # reported at the emission line
+    b33 = only(findings, "BGT033")
+    assert len(b33) == 1 and "ghost_kind" in b33[0].message
 
 
 def test_rule_docs_catalog_matches_registry_exactly():
