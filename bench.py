@@ -567,36 +567,198 @@ def stage_canonical():
     }
 
 
-def stage_speculation():
-    """BASELINE config 5: 4 players x 16 branches x 8 frames over the
-    10k-entity world via the canonical branched program.  Value = lane-0
-    USEFUL frames/s (one authoritative lane of the 16-branch dispatch)."""
-    jax = _stage_setup()
-    import jax.numpy as jnp
-    from bevy_ggrs_tpu.models import stress
+SVC_ENTITIES = 65536
+SVC_TICKS = 150
+SVC_WARM = 60
+SVC_MIN_P99_SPEEDUP = 5.0
+SVC_MIN_HIT_RATE = 0.5
 
-    app = stress.make_app(N_ENTITIES, num_players=4)
-    app.canonical_depth = DEPTH
-    app.canonical_branches = SPEC_BRANCHES
-    world = app.init_state()
-    spec = app.branched_fn
-    bi = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.uint8))
-    bs = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.int8))
-    nr = jax.device_put(jnp.full((SPEC_BRANCHES,), DEPTH, jnp.int32))
-    out = spec(world, bi, bs, 0, nr)
-    jax.block_until_ready(out)
-    samples = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        for i in range(ITERS):
-            out = spec(world, bi, bs, i, nr)
-        jax.block_until_ready(out)
-        samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
-    fps, spread = _median_spread(samples)
+
+def _speculation_service_arm(jax, smoke):
+    """Speculation 2.0 rollback-servicing comparison (HARD gates).
+
+    Two pipelined p2p pairs run the induced-late-input workload from
+    ``stage_netstats`` (``latency_hops=6 > input_delay=1``, inputs flipping
+    every 7 ticks): every flip forces a genuine misprediction rollback.
+    The MISS pair runs speculation-less, so each of its rollbacks pays the
+    full ring-materialize + resim servicing (``rollback_service_ms{path=
+    miss}``).  The HIT pair hedges both pads over the flip alphabet
+    ({0,1} x {0,1}); its rollbacks are served from the branch cache — a
+    bookkeeping ring pop plus device-side selects, zero resim frames
+    (``path=hit``).  Both pairs run ``measure_rollback_service=True`` so
+    the serviced device work retires inside the timed span (JAX dispatch
+    is async; without the block, p99 would time queue insertion, not
+    servicing).
+
+    HARD GATES (raise -> nonzero exit):
+
+    1. hit-path p99 is >= 5x lower than miss-path p99;
+    2. cache hit rate > 50% with the hold-last+hedged candidate set;
+    3. steady census unchanged — the HIT pair's runner-level uploads still
+       equal its dispatches (1+1 per fused advance), and every draft
+       dispatch rode exactly ONE packed upload."""
+    from bevy_ggrs_tpu import telemetry
+    from bevy_ggrs_tpu.ops.speculation import (
+        SpeculationConfig, pad_candidates,
+    )
+
+    ticks = 60 if smoke else SVC_TICKS
+    warm = 40 if smoke else SVC_WARM
+    entities = 65536 if smoke else SVC_ENTITIES
+
+    telemetry.disable()
+    telemetry.reset()
+
+    def flipping_inputs(i):
+        count = [0]
+
+        def read(handles):
+            count[0] += 1
+            return {h: np.uint8((count[0] // 7) % 2) for h in handles}
+
+        return read
+
+    def run_pair(tag, **runner_kw):
+        # warm runs with telemetry OFF: the warm slice's rollbacks carry
+        # the bucket-program compile stalls, which would otherwise land in
+        # the servicing histogram and clamp both paths' p99 at compile time
+        telemetry.disable()
+        net, runners = _make_p2p_pair(
+            True, tag, inputs=flipping_inputs, latency_hops=6,
+            input_delay=1, entities=entities,
+            measure_rollback_service=True, **runner_kw,
+        )
+        dt = 1.0 / runners[0].app.fps
+        _slice_ticks(jax, net, runners, warm, dt)
+        telemetry.enable()
+        _slice_ticks(jax, net, runners, ticks, dt)
+        return runners
+
+    # miss pair FIRST: its rollbacks populate path="miss" before the hit
+    # pair's (rare) unhedged corrections add theirs
+    miss_runners = run_pair("svcm")
+    miss_rollbacks = sum(r.rollbacks for r in miss_runners)
+    for r in miss_runners:
+        r.finish()
+    spec = SpeculationConfig(
+        candidates_fn=pad_candidates(2, [0, 1], [0, 1]),
+        depth=8, max_cached_frames=16,
+    )
+    hit_runners = run_pair("svch", speculation=spec)
+    hits = sum(r.spec_cache.hits for r in hit_runners)
+    misses = sum(r.spec_cache.misses for r in hit_runners)
+    drafts = sum(r.spec_cache.draft_dispatches for r in hit_runners)
+    draft_uploads = sum(r.spec_cache.host_uploads for r in hit_runners)
+    served = sum(r.stats()["cache_served_frames"] for r in hit_runners)
+    census = [(r.stats()["host_uploads"], r.device_dispatches)
+              for r in hit_runners]
+    for r in hit_runners:
+        r.finish()
+
+    h = telemetry.registry().histogram("rollback_service_ms")
+    p99_hit = h.percentile(0.99, path="hit")
+    p99_miss = h.percentile(0.99, path="miss")
+    p50_hit = h.percentile(0.5, path="hit")
+    p50_miss = h.percentile(0.5, path="miss")
+    telemetry.disable()
+    telemetry.reset()
+
+    if miss_rollbacks == 0 or p99_miss is None:
+        raise RuntimeError(
+            "speculation gate: the induced-late-input pair forced no "
+            "miss-path rollbacks — the comparison is void"
+        )
+    if hits == 0 or p99_hit is None:
+        raise RuntimeError(
+            "speculation gate: the hedged pair served no cache hits "
+            f"(hits={hits} misses={misses}) — drafts never verified"
+        )
+    hit_rate = hits / max(hits + misses, 1)
+    if hit_rate <= SVC_MIN_HIT_RATE:
+        raise RuntimeError(
+            f"speculation gate: hit rate {hit_rate:.2f} <= "
+            f"{SVC_MIN_HIT_RATE} with hold-last hedged drafts "
+            f"(hits={hits} misses={misses})"
+        )
+    if p99_miss < SVC_MIN_P99_SPEEDUP * p99_hit:
+        raise RuntimeError(
+            "speculation gate: hit-path rollback servicing p99 "
+            f"{p99_hit:.3f}ms is not >= {SVC_MIN_P99_SPEEDUP}x lower than "
+            f"miss-path p99 {p99_miss:.3f}ms"
+        )
+    for u, d in census:
+        if u != d:
+            raise RuntimeError(
+                "speculation gate: the hedged pair broke the steady packed "
+                f"census — {u} uploads for {d} dispatches (required 1+1; "
+                "drafts must ride their own packed staging)"
+            )
+    if drafts == 0 or draft_uploads != drafts:
+        raise RuntimeError(
+            f"speculation gate: {drafts} draft dispatches took "
+            f"{draft_uploads} uploads (required: exactly one packed upload "
+            "per draft)"
+        )
     return {
-        "spec_fps": round(fps, 1), "spec_spread": round(spread, 3),
-        "platform": jax.devices()[0].platform,
+        "speculation_rollback_service_p99_ms_hit": round(p99_hit, 3),
+        "speculation_rollback_service_p99_ms_miss": round(p99_miss, 3),
+        "speculation_rollback_service_p50_ms_hit": round(p50_hit, 3),
+        "speculation_rollback_service_p50_ms_miss": round(p50_miss, 3),
+        "speculation_service_p99_speedup": round(p99_miss / p99_hit, 2),
+        "speculation_hit_rate": round(hit_rate, 3),
+        "speculation_hits": hits,
+        "speculation_misses": misses,
+        "speculation_cache_served_frames": served,
+        "speculation_draft_dispatches": drafts,
+        "speculation_service_entities": entities,
+        "speculation_rep_policy": (
+            f"two p2p pairs (latency_hops=6, input_delay=1, inputs flip "
+            f"every 7 ticks, {entities} entities), {ticks} measured ticks "
+            f"after {warm} warm; p99 from rollback_service_ms{{path}} with "
+            "in-span block_until_ready (measure_rollback_service)"),
     }
+
+
+def stage_speculation():
+    """BASELINE config 5 (canonical branched throughput: 4 players x 16
+    branches x 8 frames over the 10k-entity world, value = lane-0 USEFUL
+    frames/s) plus the Speculation 2.0 rollback-servicing arm — hit-path
+    vs miss-path ``rollback_service_ms`` p99 under an induced-late-input
+    p2p workload, with the >=5x / >50%-hit-rate / census HARD gates
+    (:func:`_speculation_service_arm`).  ``BGT_BENCH_SMOKE=1`` skips the
+    throughput arm and shrinks the servicing windows; every gate stays
+    armed."""
+    jax = _stage_setup()
+    smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
+    out = {}
+    if not smoke:
+        import jax.numpy as jnp
+        from bevy_ggrs_tpu.models import stress
+
+        app = stress.make_app(N_ENTITIES, num_players=4)
+        app.canonical_depth = DEPTH
+        app.canonical_branches = SPEC_BRANCHES
+        world = app.init_state()
+        spec = app.branched_fn
+        bi = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.uint8))
+        bs = jax.device_put(jnp.zeros((SPEC_BRANCHES, DEPTH, 4), jnp.int8))
+        nr = jax.device_put(jnp.full((SPEC_BRANCHES,), DEPTH, jnp.int32))
+        o = spec(world, bi, bs, 0, nr)
+        jax.block_until_ready(o)
+        samples = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            for i in range(ITERS):
+                o = spec(world, bi, bs, i, nr)
+            jax.block_until_ready(o)
+            samples.append(DEPTH * ITERS / (time.perf_counter() - t0))
+        fps, spread = _median_spread(samples)
+        out.update({
+            "spec_fps": round(fps, 1), "spec_spread": round(spread, 3),
+        })
+    out.update(_speculation_service_arm(jax, smoke))
+    out["platform"] = jax.devices()[0].platform
+    return out
 
 
 def stage_layouts():
@@ -889,15 +1051,21 @@ def stage_uploads():
     must retire as ONE dispatch fed by ONE upload (the device-resident
     snapshot ring absorbs the loads).  Frame-advantage throttling makes a
     few flushes owe 7 or 9; those are excluded from the gate but counted.
+    Arm 3 is the arm-1 pair with ``input_queue=True`` — the rotating
+    device-resident staging queue (utils/staging.StagingQueue) that moves
+    the transfer-safety block off the tick's critical path; its census must
+    stay EXACTLY 1 upload + 1 dispatch per frame, the rotation only changes
+    WHEN the block happens.
 
     HARD GATES (raise -> nonzero exit):
 
     1. packed steady state — host uploads == device dispatches == frames
        advanced over the measured window (1 upload + 1 dispatch per tick);
     2. megastep — every flush owing exactly N frames cost exactly 1
-       dispatch + 1 upload, and at least half the flushes were exact.
+       dispatch + 1 upload, and at least half the flushes were exact;
+    3. input queue — same 1+1 census as arm 1 over the rotating buffers.
 
-    ``BGT_BENCH_SMOKE=1`` shrinks the windows; both gates stay armed."""
+    ``BGT_BENCH_SMOKE=1`` shrinks the windows; all gates stay armed."""
     jax = _stage_setup()
 
     smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
@@ -965,6 +1133,26 @@ def stage_uploads():
             f"exactly {MEGASTEP_N} frames — the cadence never settled, the "
             "census is void"
         )
+
+    # -- arm 3: device-resident input queue census ------------------------
+    net_q, q_runners = _make_p2p_pair(True, "upq", input_queue=True)
+    _slice_ticks(jax, net_q, q_runners, UPLOADS_WARM, dt)
+    q0 = q_runners[0]
+    d0, u0, f0 = (q0.device_dispatches, q0.stats()["host_uploads"], q0.frame)
+    _slice_ticks(jax, net_q, q_runners, ticks, dt)
+    stq = q0.stats()
+    queue_d = q0.device_dispatches - d0
+    queue_u = stq["host_uploads"] - u0
+    queue_f = q0.frame - f0
+    for r in q_runners:
+        r.finish()
+    if not (queue_d == queue_u == queue_f and queue_f > 0):
+        raise RuntimeError(
+            f"uploads gate: input-queue tick census broke — {queue_f} "
+            f"frames took {queue_d} dispatches and {queue_u} uploads "
+            "(required: 1 + 1 per frame; the rotation must not add or "
+            "drop uploads)"
+        )
     return {
         "uploads_per_tick_packed": round(packed_u / packed_f, 3),
         "dispatches_per_tick_packed": round(packed_d / packed_f, 3),
@@ -975,10 +1163,14 @@ def stage_uploads():
         "megastep_flushes": flushes,
         "megastep_n": MEGASTEP_N,
         "megastep_fused_ring_loads": ms_stats["fused_ring_loads"],
+        "uploads_per_tick_input_queue": round(queue_u / queue_f, 3),
+        "input_queue_landed_free": stq["staging_landed_free"],
+        "input_queue_deferred_blocks": stq["staging_deferred_blocks"],
         "uploads_rep_policy": (
             f"steady p2p census over {ticks} ticks after {UPLOADS_WARM} "
             f"warm; megastep census over {flushes} x {MEGASTEP_N}-frame "
-            "flushes, gate on exactly-N flushes only"),
+            "flushes, gate on exactly-N flushes only; input-queue census "
+            f"over the same {ticks}-tick window with rotating staging"),
         "platform": jax.devices()[0].platform,
     }
 
@@ -1673,12 +1865,14 @@ def orchestrate():
 
 
 def smoke():
-    """CI smoke: the batched + sharded + netstats + uploads + trace stages
-    only, 1 rep, small iter counts — seconds, not minutes — with every
-    hard gate fully armed (a dispatch-count regression in either executor,
-    a broken rollback-cause invariant, a sampler-cost regression, an extra
-    host->device upload on the packed/megastep paths, a malformed Chrome
-    trace, or trace-recording overhead past 2% fails this run).
+    """CI smoke: the batched + sharded + netstats + uploads + speculation +
+    trace stages only, 1 rep, small iter counts — seconds, not minutes —
+    with every hard gate fully armed (a dispatch-count regression in either
+    executor, a broken rollback-cause invariant, a sampler-cost regression,
+    an extra host->device upload on the packed/megastep/input-queue paths,
+    a hit-path rollback-servicing p99 that is not >=5x below the miss path,
+    a malformed Chrome trace, or trace-recording overhead past 2% fails
+    this run).
     The sharded stage runs under forced 8-virtual-device CPU so the mesh
     path is exercised even on single-chip hosts; netstats runs on CPU (its
     gates are host-loop properties, not device throughput).  Wired into
@@ -1715,6 +1909,14 @@ def smoke():
     if uploads is None:
         print(f"bench smoke FAILED (uploads stage): {err}", file=sys.stderr)
         sys.exit(1)
+    speculation, err = _run_stage(
+        "speculation", timeout_s=540, force_cpu=True,
+        extra_env={"BGT_BENCH_SMOKE": "1"},
+    )
+    if speculation is None:
+        print(f"bench smoke FAILED (speculation stage): {err}",
+              file=sys.stderr)
+        sys.exit(1)
     trace, err = _run_stage(
         "trace", timeout_s=300, force_cpu=True,
         extra_env={"BGT_BENCH_SMOKE": "1"},
@@ -1729,6 +1931,8 @@ def smoke():
                                    if k != "platform"},
                       "uploads": {k: v for k, v in uploads.items()
                                   if k != "platform"},
+                      "speculation": {k: v for k, v in speculation.items()
+                                      if k != "platform"},
                       "trace": {k: v for k, v in trace.items()
                                 if k != "platform"}}))
 
@@ -1737,8 +1941,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", choices=sorted(STAGES), default=None)
     ap.add_argument("--smoke", action="store_true",
-                    help="batched + sharded + netstats + uploads + trace "
-                         "stages only, 1 rep, all hard gates armed")
+                    help="batched + sharded + netstats + uploads + "
+                         "speculation + trace stages only, 1 rep, all "
+                         "hard gates armed")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="with --stage trace: also write the validated "
                          "Chrome-trace JSON here (load in ui.perfetto.dev)")

@@ -46,15 +46,23 @@ modes are refused for the same reason (make_batched_resim_fn docstring).
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from . import telemetry
 from .app import App
-from .ops.batch import BucketedWaveExecutor, ShardedWaveExecutor, stack_worlds
+from .ops.batch import (
+    BucketedWaveExecutor,
+    DraftWaveScheduler,
+    ShardedWaveExecutor,
+    stack_worlds,
+)
+from .ops.speculation import SpeculationCache, SpeculationConfig
 from .session.events import (
     DesyncDetected,
+    InputStatus,
     MismatchedChecksumError,
     NotSynchronizedError,
     PredictionThresholdError,
@@ -181,6 +189,7 @@ class BatchedRunner:
         pipeline: bool = True,
         packed: bool = True,
         mesh=None,
+        speculation: Optional[SpeculationConfig] = None,
     ):
         if app.canonical_depth is not None or app.canonical_branches is not None:
             raise ValueError(
@@ -327,6 +336,69 @@ class BatchedRunner:
                 self._devmem_tag + "/packed_staging",
                 self._stage_packed.nbytes,
             )
+        # Speculative draft waves (docs/architecture.md "Speculative rollback
+        # servicing"): per-lobby branch caches filled by an EXTRA wave that
+        # only occupies lanes the active bucket left idle; on a LoadRequest
+        # whose corrected run was fully hedged the rollback is served as a
+        # row scatter of the cached final plus LazySlice ring pushes —
+        # zero resim frames.  The mode matrix is strict (ValueError, never a
+        # silent fallback): drafts ride the packed batch staging, cached
+        # branch states scatter STRAIGHT into the resident world so the
+        # snapshot strategy must be identity, and the draft gather/scatter
+        # is not yet shard-aware.
+        self.spec_caches: Optional[List[SpeculationCache]] = None
+        self.spec_config = speculation
+        self.draft_waves = 0
+        self.cache_served_frames = 0
+        self._last_wave = None  # (prev_worlds, stacked, ks) of last run wave
+        self._last_adv: Optional[List[list]] = None
+        self._draft_sched: Optional[DraftWaveScheduler] = None
+        self._stage_packed_draft = None
+        if speculation is not None:
+            if not self.packed:
+                raise ValueError(
+                    "BatchedRunner speculation requires packed=True: draft "
+                    "waves ride the packed single-upload batch staging "
+                    "(mode matrix in docs/architecture.md)"
+                )
+            if not self.app.reg.is_identity_strategy():
+                raise ValueError(
+                    "BatchedRunner speculation requires an identity snapshot "
+                    "strategy: cached branch states scatter straight into "
+                    "the resident stacked world on a hit (mode matrix in "
+                    "docs/architecture.md)"
+                )
+            if self.mesh is not None:
+                raise ValueError(
+                    "BatchedRunner speculation is not shard-aware yet: the "
+                    "draft wave's base gather and hit scatter assume a "
+                    "single-device resident world (mode matrix in "
+                    "docs/architecture.md)"
+                )
+            depth = max(speculation.depth, 1)
+            if depth > self.k_max:
+                raise ValueError(
+                    f"speculation depth {depth} exceeds k_max={self.k_max}; "
+                    "drafts dispatch through the same bucketed wave "
+                    "executor as real runs"
+                )
+            self.spec_caches = [
+                SpeculationCache(app, speculation) for _ in range(m)
+            ]
+            self._draft_sched = DraftWaveScheduler(m_pad)
+            self._draft_bucket = self.exec.bucket_for(depth)
+            self._stage_packed_draft = app.packed_spec.new_batch_buffer(
+                m_pad, self._draft_bucket
+            )
+            telemetry.devmem.note(
+                self._devmem_tag + "/draft_staging",
+                self._stage_packed_draft.nbytes,
+            )
+            self._m_drafts = telemetry.registry().bind_counter(
+                "draft_dispatches_total",
+                "speculative draft dispatches issued into idle pipeline "
+                "slots / spare wave lanes",
+            )
         # stable bound-method refs: snapshot-strategy hooks fused into the
         # batched load/save programs (and the jit-cache keys of
         # fused_load_rows / fused_gather_rows)
@@ -382,12 +454,18 @@ class BatchedRunner:
         for b, s in enumerate(self.sessions):
             per_lobby_ops.append(self._collect_ops(b, s))
         n_waves = max((len(ops) for ops in per_lobby_ops), default=0)
+        self._last_wave = None
+        self._last_adv = None
         for w in range(n_waves):
             wave_ops = [
                 ops[w] if w < len(ops) else None for ops in per_lobby_ops
             ]
-            self._do_loads(wave_ops)
+            self._do_loads(wave_ops, per_lobby_ops, w)
             self._do_runs(wave_ops)
+        if self.spec_caches is not None:
+            # hedge the tick's predicted transitions into the lanes the last
+            # run wave left idle (draft capacity, not extra census)
+            self._speculate_idle_lanes()
         for b, s in enumerate(self.sessions):
             cf = s.confirmed_frame()
             self.confirmed[b] = cf
@@ -469,7 +547,12 @@ class BatchedRunner:
 
     # -- loads --------------------------------------------------------------
 
-    def _do_loads(self, wave_ops: List[Optional[_Op]]) -> None:
+    def _do_loads(
+        self,
+        wave_ops: List[Optional[_Op]],
+        per_lobby_ops: Optional[List[List[_Op]]] = None,
+        w: int = 0,
+    ) -> None:
         loads = [
             (b, op.load_frame, op.load_cause)
             for b, op in enumerate(wave_ops)
@@ -511,6 +594,84 @@ class BatchedRunner:
                     handle=blamed, lateness=lateness,
                     cause_kind=cause.kind if cause is not None else "unknown",
                 )
+        # Speculation hit servicing: a Load whose FOLLOWING run (the next
+        # wave's op for that lobby) was fully hedged is served entirely from
+        # the lobby's branch cache — the ring pop is bookkeeping, the world
+        # restore is one row scatter of the cached final, the run's saves
+        # become LazySlice handles into the branch stack, and the consumed
+        # run op is blanked so the next wave never dispatches it.  Partial
+        # hits (corrected inputs hedged for a prefix only) fall through to
+        # the miss path: serving them would split one run op across cache
+        # and wave, shifting every other lobby's wave alignment.
+        hits: Dict[int, tuple] = {}
+        if self.spec_caches is not None and per_lobby_ops is not None:
+            for b, f, _c in loads:
+                ops_b = per_lobby_ops[b]
+                nxt = ops_b[w + 1] if w + 1 < len(ops_b) else None
+                if nxt is None or not nxt.run:
+                    continue
+                advs = [r for r in nxt.run if isinstance(r, AdvanceRequest)]
+                if not advs:
+                    continue
+                got = self.spec_caches[b].lookup_seq(
+                    f, np.stack([a.inputs for a in advs])
+                )
+                full = got is not None and got[0] == len(advs)
+                telemetry.count(
+                    "speculation_hits_total" if full
+                    else "speculation_misses_total",
+                    help="speculative branch-cache lookups",
+                )
+                if full:
+                    hits[b] = (f, got, nxt)
+        if hits:
+            t_hit = time.perf_counter()
+            with self._phases.phase("rollback_load"), span("LoadWorldBatched"):
+                for b, (f, got, nxt) in hits.items():
+                    d, states_fn, checks_b = got
+                    # bookkeeping-only rollback: pop the newer ring entries,
+                    # keep the target's stored handle for leading saves
+                    stored, cs0 = self.rings[b].rollback(f)
+                    self.spec_caches[b].invalidate_after(f)
+                    cbc = BatchChecks(checks_b)
+                    self.worlds = _set_row(self.worlds, b, states_fn(d - 1))
+                    self.device_dispatches += 1
+                    self._m_dispatches.inc()
+                    if self.pipeline:
+                        self._rbq.start(cbc)
+                    self._world_checksum[b] = cbc.ref(d - 1)
+                    self.frames[b] = frame_add(f, d)
+                    self.cache_served_frames += d
+                    c = 0
+                    for r in nxt.run:
+                        if isinstance(r, AdvanceRequest):
+                            c += 1
+                        elif c == 0:
+                            self.rings[b].push(r.frame, (stored, cs0))
+                            r.cell.save(r.frame, cs0)
+                        else:
+                            cs = cbc.ref(c - 1)
+                            self.rings[b].push(
+                                r.frame,
+                                (LazySlice(states_fn.stacked, c - 1), cs),
+                            )
+                            r.cell.save(r.frame, cs)
+                    per_lobby_ops[b][w + 1] = None  # run consumed
+                    telemetry.record(
+                        "speculation_hit", lobby=b, frame=f, depth=d,
+                        advances=d,
+                    )
+            telemetry.observe(
+                "rollback_service_ms", (time.perf_counter() - t_hit) * 1e3,
+                "wall ms to service one rollback (LoadRequest + its "
+                "following Advance/Save run)",
+                buckets=telemetry.LATENCY_MS_BUCKETS,
+                path="hit",
+            )
+            loads = [(b, f, c) for b, f, c in loads if b not in hits]
+            if not loads:
+                return
+        t_miss = time.perf_counter()
         with self._phases.phase("rollback_load"), span("LoadWorldBatched"):
             # batched mixed-source load: roll every ring back, group the
             # stored LazySlice handles by backing stacked buffer, and serve
@@ -545,6 +706,19 @@ class BatchedRunner:
                 self._world_checksum[b] = cs
             for b, f, _c in loads:
                 self.frames[b] = f
+                if self.spec_caches is not None:
+                    # branches hedged from now-superseded predicted states
+                    # must not serve future lookups (SpeculationCache
+                    # .invalidate_after)
+                    self.spec_caches[b].invalidate_after(f)
+        if self.spec_caches is not None:
+            telemetry.observe(
+                "rollback_service_ms", (time.perf_counter() - t_miss) * 1e3,
+                "wall ms to service one rollback (LoadRequest + its "
+                "following Advance/Save run)",
+                buckets=telemetry.LATENCY_MS_BUCKETS,
+                path="miss",
+            )
 
     # -- runs ---------------------------------------------------------------
 
@@ -648,6 +822,12 @@ class BatchedRunner:
                         self._world_checksum[b] = batch.ref(
                             b * bucket + ks[b] - 1
                         )
+            if self.spec_caches is not None:
+                # draft-wave inputs (_speculate_idle_lanes): which lanes the
+                # active bucket left idle, and each drafting lobby's base
+                # state (the one feeding its LAST advance)
+                self._last_wave = (prev_worlds, stacked, list(ks))
+                self._last_adv = adv
         with ph.phase("store_save"), span("SaveWorldBatched"):
             # collect this wave's saves as (lobby, advance-count-before, req)
             saves = []
@@ -698,6 +878,120 @@ class BatchedRunner:
                 # non-blocking peek() for the pipelined consume path)
                 r.cell.save(r.frame, cs)
 
+    # -- speculative draft waves --------------------------------------------
+
+    def _speculate_idle_lanes(self) -> None:
+        """One EXTRA packed wave that fills ONLY the lanes the tick's last
+        run wave left idle (``ks[b] == 0``) with candidate-input draft
+        branches, assigned by :class:`~.ops.batch.DraftWaveScheduler`.
+
+        Each assigned lane loads its drafting lobby's pre-advance base state
+        (a LazySlice gather into a functional COPY of the resident world —
+        the live state is never touched), advances its candidate row
+        ``depth`` frames, and the stacked outputs fill the lobby's branch
+        cache for ``_do_loads``'s verified-hit servicing.  A tick with no
+        idle lanes, or no predicted last advance, drafts nothing — drafts
+        consume spare lanes, never widen the active bucket."""
+        if self._last_wave is None:
+            return
+        prev_worlds, stacked, ks = self._last_wave
+        adv = self._last_adv
+        m = len(self.sessions)
+        cfg = self.spec_config
+        depth = max(cfg.depth, 1)
+        idle = [b for b in range(m) if ks[b] == 0]
+        if not idle:
+            return
+        wants = []
+        cands_by_lobby: Dict[int, np.ndarray] = {}
+        for b in range(m):
+            a = adv[b]
+            if not a or ks[b] == 0:
+                continue
+            last = a[-1]
+            if not np.any(np.asarray(last.status) == InputStatus.PREDICTED):
+                continue
+            cands = np.asarray(
+                cfg.candidates_fn(last.inputs), self.app.input_dtype
+            )
+            if cands.shape[0] == 0:
+                continue
+            cands_by_lobby[b] = cands
+            wants.append((b, cands.shape[0]))
+        if not wants:
+            return
+        plan = self._draft_sched.plan(idle, wants)
+        if not plan:
+            return
+        rows = []
+        for b, _ci, lane in plan:
+            kb = ks[b]
+            # the state feeding the lobby's LAST advance: the second-newest
+            # stacked frame, or (single-advance waves) the pre-wave resident
+            # row — same derivation as GgrsRunner's last_adv_src
+            src = (
+                LazySlice(stacked, (b, kb - 2)) if kb >= 2
+                else LazySlice(prev_worlds, b)
+            )
+            rows.append((lane, src))
+        with self._phases.phase("wave_dispatch"), span("DraftWaveBatched"):
+            groups, fallback = plan_row_gather(rows)
+            draft_worlds = self.worlds
+            if groups:
+                draft_worlds = fused_load_rows(draft_worlds, groups, None)
+                self.device_dispatches += 1
+                self._m_dispatches.inc()
+            for lane, stored in fallback:
+                draft_worlds = _set_row(
+                    draft_worlds, lane, materialize(stored)
+                )
+                self.device_dispatches += 1
+                self._m_dispatches.inc()
+            from .ops.packing import pack_prefix, pack_row, repeat_last_row
+
+            pspec = self.app.packed_spec
+            packed = self._stage_packed_draft
+            bucket = self._draft_bucket
+            draft_ks = [0] * self._m_pad
+            zero_status = np.zeros((self._np,), np.int8)
+            for b, ci, lane in plan:
+                lane_buf = packed[lane]
+                pack_prefix(lane_buf, frame_add(self.frames[b], -1), depth)
+                pack_row(
+                    pspec, lane_buf, 0, cands_by_lobby[b][ci], zero_status
+                )
+                repeat_last_row(lane_buf, 1, bucket)
+                draft_ks[lane] = depth
+            for lane in range(self._m_pad):
+                if draft_ks[lane] == 0:
+                    # unassigned lanes must read n_real=0 even if a past
+                    # draft wave left payload bytes behind
+                    pack_prefix(packed[lane], 0, 0)
+            _b, _finals, d_stacked, d_checks = self.exec.run_wave_packed(
+                draft_worlds, packed, draft_ks
+            )
+            # finals are DISCARDED: drafts never touch the resident world
+            self.device_dispatches += 1
+            self._m_dispatches.inc()
+            self.draft_waves += 1
+            self._m_drafts.inc()
+        import jax as _jax
+
+        by_lobby: Dict[int, list] = {}
+        for b, ci, lane in plan:
+            by_lobby.setdefault(b, []).append((ci, lane))
+        checks_m = d_checks.reshape(self._m_pad, bucket, 2)
+        for b, pairs in by_lobby.items():
+            lanes = np.array([lane for _ci, lane in pairs], np.int32)
+            cands_b = np.stack(
+                [cands_by_lobby[b][ci] for ci, _lane in pairs]
+            )
+            stacked_l = _jax.tree.map(lambda a: a[lanes, :depth], d_stacked)
+            self.spec_caches[b].fill_from_branched(
+                frame_add(self.frames[b], -1), cands_b, stacked_l,
+                checks_m[lanes, :depth], offset=0, depth_eff=depth,
+            )
+
     # -- observability ------------------------------------------------------
 
     def _report_mismatch(self, b: int, e: MismatchedChecksumError) -> None:
@@ -731,6 +1025,18 @@ class BatchedRunner:
             "confirmed": list(self.confirmed),
             "phases": self._phases.totals(),
         }
+        if self.spec_caches is not None:
+            out["speculation"] = {
+                "hits": sum(c.hits for c in self.spec_caches),
+                "misses": sum(c.misses for c in self.spec_caches),
+                "draft_waves": self.draft_waves,
+                "draft_lanes_filled": self._draft_sched.lanes_filled,
+                "dropped_candidates": self._draft_sched.dropped_candidates,
+                "cache_served_frames": self.cache_served_frames,
+                "cached_bytes": sum(
+                    c.cached_bytes for c in self.spec_caches
+                ),
+            }
         if self.planner is not None:
             out["sharded"] = {
                 "devices": self.planner.n_devices,

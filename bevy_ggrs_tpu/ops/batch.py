@@ -1016,3 +1016,50 @@ def _pad_rows(tree, pad: int):
 
         fn = _pad_rows_jits[pad] = jax.jit(body)
     return fn(tree)
+
+
+class DraftWaveScheduler:
+    """Assign speculative draft branches to the wave lanes the active bucket
+    left idle (BatchedRunner speculation — docs/architecture.md "Speculative
+    rollback servicing").
+
+    The batched tick's run wave only occupies the lanes of lobbies that
+    advanced this tick with ``ks[b] > 0``; the rest of the ``[M, ...]``
+    dispatch is dead weight.  ``plan()`` fills exactly those idle lanes with
+    candidate branches — round-robin across the drafting lobbies so one
+    lobby's wide candidate fan cannot starve the rest — and NEVER touches an
+    active lane, so the draft wave's lane census is disjoint from the real
+    wave's by construction.  Candidates that do not fit this tick are
+    dropped (counted in ``dropped_candidates``), not queued: a stale draft
+    for a frame the session has moved past can never be looked up again."""
+
+    def __init__(self, m_pad: int):
+        self.m_pad = int(m_pad)
+        self.waves_planned = 0
+        self.lanes_filled = 0
+        self.dropped_candidates = 0
+
+    def plan(
+        self, idle_lanes: List[int], wants: List[Tuple[int, int]]
+    ) -> List[Tuple[int, int, int]]:
+        """``wants`` is ``[(lobby, n_candidates)]``; returns assignments
+        ``[(lobby, candidate_index, lane)]`` using at most the given idle
+        lanes."""
+        lanes = list(idle_lanes)
+        queues = [[b, 0, n] for b, n in wants if n > 0]  # lobby, next, total
+        out: List[Tuple[int, int, int]] = []
+        qi = 0
+        while lanes and queues:
+            if qi >= len(queues):
+                qi = 0
+            b, nxt, total = queues[qi]
+            out.append((b, nxt, lanes.pop(0)))
+            queues[qi][1] = nxt + 1
+            if nxt + 1 >= total:
+                queues.pop(qi)
+            else:
+                qi += 1
+        self.waves_planned += 1
+        self.lanes_filled += len(out)
+        self.dropped_candidates += sum(t - n for _b, n, t in queues)
+        return out

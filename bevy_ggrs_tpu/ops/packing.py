@@ -64,6 +64,8 @@ class PackedSpec:
 
     @classmethod
     def from_parts(cls, players: int, input_shape, input_dtype) -> "PackedSpec":
+        """Derive the row layout from the app's player count and per-player
+        input shape/dtype (width 4-aligned, never below the prefix)."""
         input_shape = tuple(input_shape)
         input_dtype = np.dtype(input_dtype)
         elems = prod(input_shape) if input_shape else 1

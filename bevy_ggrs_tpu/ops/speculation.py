@@ -65,6 +65,7 @@ class SpeculationCache:
         self.misses = 0
         self.branches_evaluated = 0
         self.bytes_evicted = 0  # device bytes dropped by the BYTE budget only
+        self.draft_dispatches = 0  # speculative fan-out dispatches issued
         # Packed single-upload staging for the speculate dispatch (same
         # scheme as the runner's resim path — ops/packing.py): persistent
         # [M, depth+1, W] int8 buffer, grown geometrically if M changes.
@@ -83,6 +84,11 @@ class SpeculationCache:
         self._m_packed_bytes = _treg.bind_counter(
             "packed_upload_bytes",
             "bytes staged through packed single-upload buffers",
+        )
+        self._m_drafts = _treg.bind_counter(
+            "draft_dispatches_total",
+            "speculative draft dispatches issued into idle pipeline slots "
+            "/ spare wave lanes",
         )
         # device-memory accounting (telemetry/devmem.py): the branch cache
         # pins whole speculated worlds — exactly the residency the HBM
@@ -161,6 +167,8 @@ class SpeculationCache:
             )
             self.host_uploads += 3
             self._m_uploads.observe(3)
+        self.draft_dispatches += 1
+        self._m_drafts.inc()
         self.branches_evaluated += m * depth
         entry = {}
         for b in range(m):
@@ -221,7 +229,14 @@ class SpeculationCache:
         self.hits += 1
         from .resim import slice_frame
 
-        return d, (lambda i: slice_frame(stacked_b, i)), checks_b
+        def states_fn(i):
+            return slice_frame(stacked_b, i)
+
+        # the raw [depth, ...] branch stack, for callers that want deferred
+        # LazySlice handles instead of eager per-frame selects (the batched
+        # runner's ring pushes)
+        states_fn.stacked = stacked_b
+        return d, states_fn, checks_b
 
     def lookup(self, start_frame: int, inputs: np.ndarray) -> Optional[Tuple]:
         """Single-frame convenience: (state, checksum) or None."""
@@ -280,6 +295,23 @@ class SpeculationCache:
         self._cache.clear()
         self._entry_bytes.clear()
         self._renote()
+
+    def drain_drafts(self) -> None:
+        """Retire every in-flight draft dispatch (measurement aid).
+
+        The runner's ``measure_rollback_service`` mode calls this at the
+        speculation flush seam so draft compute is charged to the idle slot
+        that issued it — without the barrier, a later rollback's servicing
+        span would transitively wait on the draft program (the device
+        serializes) and the ``path=hit`` histogram would time drafts."""
+        import jax
+
+        for _depth, entry in self._cache.values():
+            for stacked_b, checks_b in entry.values():
+                # bgt: ignore[BGT011]: deliberate — measurement mode only
+                # (GgrsRunner._flush_speculation under
+                # measure_rollback_service); never on the steady tick path
+                jax.block_until_ready(stacked_b)
 
 
 def jax_tree_slice(tree, idx):

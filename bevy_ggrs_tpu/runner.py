@@ -62,8 +62,10 @@ class GgrsRunner:
         on_confirmed: Optional[Callable[[int], None]] = None,
         coalesce_frames: int = 1,
         pipeline: bool = True,
-        packed: bool = True,
+        packed: Optional[bool] = None,
         megastep: bool = False,
+        input_queue: bool = False,
+        measure_rollback_service: bool = False,
     ):
         self.app = app
         self.read_inputs = read_inputs or (lambda handles: {h: app.zero_inputs()[h] for h in handles})
@@ -114,6 +116,11 @@ class GgrsRunner:
         self.spec_cache = (
             SpeculationCache(app, speculation) if speculation is not None else None
         )
+        # ordered cache-maintenance ops — ("inv", frame) invalidations and
+        # ("spec", src_fn, ring_handle, start_frame, inputs) hedges —
+        # recorded during request handling, applied in recorded order by
+        # _flush_speculation at the next seam
+        self._pending_speculate = []
         # observability counters (network_stats covers the wire; these cover
         # the sim driver — rollback frequency/depth is THE rollback-netcode
         # health metric)
@@ -171,12 +178,41 @@ class GgrsRunner:
         # dispatch uploads (inputs, status, frame scalar) fuse into ONE
         # persistent int8 buffer split in-program by a pure bitcast —
         # killing 2/3 of the per-tick link-latency share the dispatch-floor
-        # census attributed to uploads (docs/dispatch_floor.md).  Falls
-        # back to the unpacked path automatically when the app has no
-        # packed program (canonical_branches mode).
-        self.packed = bool(packed) and app.packed_resim_fn is not None
+        # census attributed to uploads (docs/dispatch_floor.md).  Tri-state:
+        # None (the default) auto-falls-back to the unpacked path when the
+        # app has no packed program (canonical_branches mode); an EXPLICIT
+        # packed=True raises instead of silently degrading — the mode
+        # matrix in docs/architecture.md "Speculative rollback servicing".
+        if packed is None:
+            self.packed = app.packed_resim_fn is not None
+        else:
+            self.packed = bool(packed)
+            if self.packed and app.packed_resim_fn is None:
+                raise ValueError(
+                    "packed=True but the app ships no packed program "
+                    "(canonical_branches keeps its own [B, K] dispatch "
+                    "shape); pass packed=None to allow the automatic "
+                    "three-upload fallback — see the mode matrix in "
+                    "docs/architecture.md"
+                )
         self._stage_packed: Optional[np.ndarray] = None
         self._packed_cap = 0
+        # Device-resident input queue (utils/staging.StagingQueue): rotate
+        # the packed staging buffers so the per-upload transfer block
+        # overlaps the NEXT tick's host work instead of stalling this one
+        self.input_queue = bool(input_queue)
+        if self.input_queue and not self.packed:
+            raise ValueError(
+                "input_queue rotates the packed staging buffer and so "
+                "requires the packed upload path; enable packed (or drop "
+                "input_queue) — see the mode matrix in docs/architecture.md"
+            )
+        self._packed_queue = None  # StagingQueue, sized lazily
+        # Honest rollback-servicing latency (bench.py stage_speculation):
+        # close the async-dispatch window inside the measured span so the
+        # hit/miss rollback_service_ms histograms compare retired work
+        self.measure_rollback_service = bool(measure_rollback_service)
+        self.cache_served_frames = 0  # rollback frames served from cache
         # Upload census (always-on plain ints, like device_dispatches):
         # host->device array uploads issued by fused dispatches, and total
         # bytes staged through packed buffers — the numbers the bench.py
@@ -556,6 +592,17 @@ class GgrsRunner:
             "speculation_hits": getattr(self.spec_cache, "hits", 0),
             "speculation_misses": getattr(self.spec_cache, "misses", 0),
             "speculation_cached_bytes": getattr(self.spec_cache, "cached_bytes", 0),
+            "speculation_draft_dispatches": getattr(
+                self.spec_cache, "draft_dispatches", 0
+            ),
+            "cache_served_frames": self.cache_served_frames,
+            "input_queue": self.input_queue,
+            "staging_deferred_blocks": getattr(
+                self._packed_queue, "deferred_blocks", 0
+            ),
+            "staging_landed_free": getattr(
+                self._packed_queue, "landed_free", 0
+            ),
             "frame": self.frame,
             "confirmed": self.confirmed,
             "pipeline": self.pipeline,
@@ -748,8 +795,17 @@ class GgrsRunner:
                         self._run_megastep(r, requests[i + 1:j])
                         i = j
                     else:
-                        self._load(r.frame, r.cause)
-                        i += 1
+                        # rollback servicing seam: the Load plus its
+                        # following Advance/Save run are one unit — a
+                        # verified speculation hit replaces BOTH the ring
+                        # materialize and the resim with cache selects
+                        j = i + 1
+                        while j < n and isinstance(
+                            requests[j], (AdvanceRequest, SaveRequest)
+                        ):
+                            j += 1
+                        self._service_rollback(r, requests[i + 1:j])
+                        i = j
                 else:
                     j = i
                     while j < n and isinstance(
@@ -774,6 +830,42 @@ class GgrsRunner:
             # hook would otherwise persist the mispredicted inputs)
             if self.on_confirmed is not None and self.confirmed != NULL_FRAME:
                 self.on_confirmed(self.confirmed)
+            # drafts for the live frame ride the idle post-tick slot: the
+            # fan-out dispatch + cache bookkeeping happen after every
+            # rollback in this list has been serviced (and timed)
+            self._flush_speculation()
+
+    def _flush_speculation(self) -> None:
+        """Apply the cache-maintenance ops recorded during request handling.
+
+        Deferral keeps the hedge fan-out (an M-branch, depth-deep dispatch
+        plus cache bookkeeping) AND the invalidation drops (synchronous
+        buffer deallocation) OFF the rollback-servicing critical path:
+        ``rollback_service_ms{path=hit}`` times the rollback itself, not
+        next tick's drafts or last tick's frees.  Ops replay in recorded
+        order, so a mid-list correction still drops the branches an earlier
+        run hedged from a superseded state.  Called before a Load's
+        servicing timer starts (same-list ordering as the old inline calls)
+        and at the end of ``_handle_requests``."""
+        pending, self._pending_speculate = self._pending_speculate, []
+        for op in pending:
+            if op[0] == "inv":
+                self.spec_cache.invalidate_after(op[1])
+                continue
+            _, src_fn, hit_handle, start, inputs = op
+            if src_fn is None:
+                # depth-1 full hit: the pre-advance source is the rollback
+                # target itself — materialize the ring handle (one slice
+                # dispatch at most; still zero resim frames)
+                src = self.app.reg.load_state(materialize(hit_handle))
+            else:
+                src = src_fn()
+            self.spec_cache.speculate(src, start, inputs)
+        if pending and self.measure_rollback_service:
+            # measurement mode only: retire drafts in the slot that issued
+            # them so no later servicing span waits on them through device
+            # serialization
+            self.spec_cache.drain_drafts()
 
     def _note_rollback(self, frame: int, cause=None) -> None:
         """Rollback attribution shared by the host-materialize load path and
@@ -861,8 +953,85 @@ class GgrsRunner:
         self._last_stacked_frame = None
         if self.spec_cache is not None:
             # branches hedged from now-superseded predicted states must not
-            # serve future lookups (see SpeculationCache.invalidate_after)
-            self.spec_cache.invalidate_after(frame)
+            # serve future lookups (see SpeculationCache.invalidate_after);
+            # the drop (buffer deallocation) is deferred to the flush seam so
+            # it stays off the timed servicing path — _flush_speculation runs
+            # before any later lookup can observe the stale entries
+            self._pending_speculate.append(("inv", frame))
+
+    def _service_rollback(self, load: LoadRequest, run: List[GgrsRequest]) -> None:
+        """Service one LoadRequest plus its following Advance/Save run.
+
+        The speculation cache is consulted FIRST: a verified hit (the
+        corrected input sequence was hedged last tick) services the rollback
+        entirely from cached branch states — the ring pop is bookkeeping
+        only (the megastep fused-load pattern), the restored state and every
+        resaved frame are device-side selects, and zero frames resimulate.
+        A miss falls back to the existing materialize + resim path.  Both
+        paths feed the ``rollback_service_ms{path=hit|miss}`` histogram —
+        the number the bench's >=5x hit-path gate reads."""
+        # issue any drafts recorded by an earlier run in this coalesced
+        # request list BEFORE the load (and before the timer): the hedge
+        # must precede the correction exactly as it did when speculate()
+        # fired inline, so invalidate_after can drop superseded branches
+        self._flush_speculation()
+        if self.measure_rollback_service:
+            import jax
+
+            # bgt: ignore[BGT010, BGT011]: deliberate — measurement mode
+            # only (bench.py _speculation_service_arm): retire the PIPELINED
+            # BACKLOG (previous ticks' advance + draft dispatches) before
+            # the timer starts, so the span times this rollback's servicing
+            # and not whatever was already in flight
+            jax.block_until_ready(self.world.comps)
+        t0 = time.perf_counter()
+        adv = [r for r in run if isinstance(r, AdvanceRequest)]
+        got = None
+        if self.spec_cache is not None and adv:
+            got = self.spec_cache.lookup_seq(
+                load.frame, np.stack([a.inputs for a in adv])
+            )
+            telemetry.count(
+                "speculation_hits_total" if got is not None
+                else "speculation_misses_total",
+                help="speculative branch-cache lookups",
+            )
+        if got is not None:
+            self._note_rollback(load.frame, load.cause)
+            with self._phases.phase("rollback_load"), span("LoadWorld"):
+                # bookkeeping-only rollback: pop the ring entries above the
+                # target and keep the stored handle — no materialize, no
+                # load_state; the world restore is the cache select inside
+                # _run_batch (O(1) in rollback depth)
+                stored, checksum = self.ring.rollback(load.frame)
+                self.frame = load.frame
+            self._pending_speculate.append(("inv", load.frame))
+            self._last_stacked = None
+            self._last_stacked_frame = None
+            self._world_donatable = False
+            telemetry.record(
+                "speculation_hit", frame=load.frame, depth=got[0],
+                advances=len(adv),
+            )
+            self._run_batch(run, hit=got, hit_pre=(stored, checksum))
+        else:
+            self._load(load.frame, load.cause)
+            self._run_batch(run)
+        if self.measure_rollback_service:
+            import jax
+
+            # bgt: ignore[BGT010, BGT011]: deliberate — measurement mode
+            # only (bench.py stage_speculation): retire the servicing work
+            # inside the timed span so hit and miss p99 compare the same
+            # thing
+            jax.block_until_ready(self.world.comps)
+        telemetry.observe(
+            "rollback_service_ms", (time.perf_counter() - t0) * 1e3,
+            "wall ms to service one rollback (LoadRequest + its following "
+            "Advance/Save run)",
+            buckets=telemetry.LATENCY_MS_BUCKETS,
+            path="hit" if got is not None else "miss",
+        )
 
     def _stage_rows(self, adv: List[AdvanceRequest]):
         """Fill the persistent pinned input/status buffers in place and
@@ -914,18 +1083,37 @@ class GgrsRunner:
         spec = self.app.packed_spec
         k = len(adv)
         kp = k_pad if k_pad is not None else k
-        if self._stage_packed is None or self._packed_cap < kp:
-            self._packed_cap = max(kp, self._packed_cap * 2)
-            self._stage_packed = spec.new_buffer(self._packed_cap)
-            telemetry.devmem.note(
-                self._devmem_tag + "/packed_staging",
-                self._stage_packed.nbytes,
-            )
-        buf = self._stage_packed
+        if self.input_queue:
+            # device-resident input queue: rotate depth-2 staging buffers so
+            # the upload overlaps the next tick's host work (StagingQueue)
+            from .utils.staging import StagingQueue
+
+            if self._packed_queue is None or self._packed_cap < kp:
+                self._packed_cap = max(kp, self._packed_cap * 2)
+                cap = self._packed_cap
+                self._packed_queue = StagingQueue(lambda: spec.new_buffer(cap))
+                telemetry.devmem.note(
+                    self._devmem_tag + "/packed_staging",
+                    self._packed_queue.nbytes,
+                )
+            buf = self._packed_queue.acquire()
+        else:
+            if self._stage_packed is None or self._packed_cap < kp:
+                self._packed_cap = max(kp, self._packed_cap * 2)
+                self._stage_packed = spec.new_buffer(self._packed_cap)
+                telemetry.devmem.note(
+                    self._devmem_tag + "/packed_staging",
+                    self._stage_packed.nbytes,
+                )
+            buf = self._stage_packed
         pack_prefix(buf, start_frame, k, has_load, load_slot)
         for i, a in enumerate(adv):
             pack_row(spec, buf, i, a.inputs, a.status)
         repeat_last_row(buf, k, kp)
+        if self.input_queue:
+            # non-blocking start: the queue blocks (if ever) at the matching
+            # acquire(), two ticks from now
+            return self._packed_queue.commit(buf[:kp + 1])
         # commit synchronously: the buffer is rewritten next dispatch and
         # the upload itself is asynchronous (see utils/staging.py)
         from .utils.staging import commit
@@ -941,13 +1129,18 @@ class GgrsRunner:
             self.packed_upload_bytes += packed_buf.nbytes
             self._m_packed_bytes.inc(packed_buf.nbytes)
 
-    def _run_batch(self, run: List[GgrsRequest]) -> None:
+    def _run_batch(self, run: List[GgrsRequest], hit=None, hit_pre=None) -> None:
         """Execute a maximal Advance/Save run as one fused device call.
 
-        With speculation enabled, the first advance is served from the
-        speculative branch cache when its inputs were hedged last tick (a
-        depth-1 rollback becomes a select), and the live frame's predicted
-        transition fans out candidate branches for the next tick."""
+        ``hit``/``hit_pre`` come from :meth:`_service_rollback` when the
+        rollback's corrected input sequence was hedged: ``hit`` is the
+        ``lookup_seq`` result serving the first ``skip`` advances as cache
+        selects (a fully-hedged rollback dispatches NO resim at all) and
+        ``hit_pre`` is the ``(stored_handle, checksum)`` the ring pop
+        returned for the rollback target — the pre-run state, needed for
+        defensive leading saves and depth-1 re-speculation.  With
+        speculation enabled the live frame's predicted transition fans out
+        candidate branches for the next tick either way."""
         adv = [r for r in run if isinstance(r, AdvanceRequest)]
         k = len(adv)
         ph = self._phases
@@ -965,30 +1158,40 @@ class GgrsRunner:
         batch_checks = None  # BatchChecks over this dispatch's stacked checksums
         skip = 0
         cache_states = cache_bc = None
-        if self.spec_cache is not None and k > 0:
-            got = self.spec_cache.lookup_seq(
-                self.frame, np.stack([a.inputs for a in adv])
-            )
+        hit_handle = hit_checksum = None
+        if hit is not None:
+            # rollback served from the speculation cache (_service_rollback
+            # already popped the ring and set self.frame to the target):
+            # state, checksum and frame advance are device-side selects of
+            # the verified branch — zero resim frames for the served prefix
+            skip, cache_states, cache_checks = hit
+            cache_bc = BatchChecks(cache_checks)
+            self.world = cache_states(skip - 1)
+            self._world_checksum = cache_bc.ref(skip - 1)
+            self.frame = frame_add(self.frame, skip)
+            self.cache_served_frames += skip
             telemetry.count(
-                "speculation_hits_total" if got is not None
-                else "speculation_misses_total",
-                help="speculative branch-cache lookups",
+                "cache_served_frames_total", skip,
+                help="rollback frames served from the speculation cache "
+                     "instead of resimulated",
             )
-            if got is not None:
-                skip, cache_states, cache_checks = got
-                cache_bc = BatchChecks(cache_checks)
-                self.world = cache_states(skip - 1)
-                self._world_checksum = cache_bc.ref(skip - 1)
-                self.frame = frame_add(self.frame, skip)
-        # state feeding the LAST advance (used to speculate the next tick).
-        # With a full cache hit (skip == k) self.world is already the
-        # POST-advance state: the pre-advance source is the previous cached
-        # frame, or for a single served advance the batch's entry state
-        # (speculating from the post-advance state would double-advance the
-        # hedge branches — states one frame ahead of their labels)
-        last_adv_src = self.world
+            hit_handle, hit_checksum = hit_pre
+        # state feeding the LAST advance (used to speculate the next tick),
+        # as a THUNK — slicing it out of a stacked buffer is a device
+        # dispatch, so resolution is deferred to _flush_speculation, off the
+        # timed servicing path.  With a full cache hit (skip == k)
+        # self.world is already the POST-advance state: the pre-advance
+        # source is the previous cached frame, or for a single served
+        # advance the rollback target itself (resolved from the ring handle
+        # at the flush — speculating from the post-advance state would
+        # double-advance the hedge branches, states one frame ahead of
+        # their labels)
+        last_adv_src = (lambda w=self.world: w)
         if skip == k:
-            last_adv_src = cache_states(skip - 2) if skip >= 2 else pre_world
+            last_adv_src = (
+                (lambda cs=cache_states, i=skip - 2: cs(i))
+                if skip >= 2 else None
+            )
         use_branched = (
             self.spec_cache is not None and self.app.canonical_branches is not None
         )
@@ -1106,7 +1309,9 @@ class GgrsRunner:
                 if fresh:
                     self._note_compile(variant, time.perf_counter() - t_build)
                 if self.spec_cache is not None and k - skip >= 2:
-                    last_adv_src = slice_frame(stacked, k - skip - 2)
+                    last_adv_src = (
+                        lambda s=stacked, i=k - skip - 2: slice_frame(s, i)
+                    )
                 self.world = final
                 self._world_checksum = batch_checks.ref(k - skip - 1)
                 self.frame = frame_add(self.frame, k - skip)
@@ -1139,6 +1344,14 @@ class GgrsRunner:
                     c += 1
                     continue
                 if c == 0:
+                    if hit is not None:
+                        # leading save after a cache-served rollback: the
+                        # live world predates the target, but the ring pop
+                        # already handed us the target's stored form —
+                        # re-push it (the megastep loaded_pair pattern)
+                        self.ring.push(r.frame, (hit_handle, hit_checksum))
+                        r.cell.save(r.frame, hit_checksum)
+                        continue
                     if c0_stored is not None:
                         # pre-resolved (donation path): pre_world's buffers
                         # may already be dead — serve from the previous
@@ -1151,7 +1364,14 @@ class GgrsRunner:
                     state_s, cs = pre_world, pre_checksum
                     pushed_pre_world = identity
                 elif c <= skip:
-                    state_s, cs = cache_states(c - 1), cache_bc.ref(c - 1)
+                    # cache-served frame: store a lazy handle into the
+                    # branch's stacked states (alias — the cache entry keeps
+                    # the buffer alive anyway); slicing dispatches only on a
+                    # later rollback, keeping hit servicing at O(1) dispatches
+                    state_s = LazySlice(cache_states.stacked, c - 1)
+                    if materialize_saves:
+                        state_s = state_s.materialize()
+                    cs = cache_bc.ref(c - 1)
                 else:
                     # defer the per-frame slice: the ring stores a handle into
                     # the stacked buffer; slicing dispatches only on rollback
@@ -1192,8 +1412,13 @@ class GgrsRunner:
             and k > 0
             and np.any(adv[-1].status == InputStatus.PREDICTED)
         ):
-            self.spec_cache.speculate(
-                last_adv_src, frame_add(self.frame, -1), adv[-1].inputs
+            # record the hedge only; _flush_speculation issues the draft
+            # fan-out at the next seam (before a following Load's timer, or
+            # at the tick boundary) so drafts ride the otherwise-idle slot
+            # instead of the rollback-servicing critical path
+            self._pending_speculate.append(
+                ("spec", last_adv_src, hit_handle,
+                 frame_add(self.frame, -1), adv[-1].inputs)
             )
 
     # -- device-resident megastep (ops/megastep.py) -------------------------

@@ -115,7 +115,7 @@ def reset() -> None:
     _SCOPE_COUNTS.clear()
 
 
-def census() -> dict:
+def census(strict: bool = False) -> dict:
     """Reconcile the registry against ``jax.live_arrays()``.
 
     Returns ``{"registered_bytes", "live_bytes", "live_arrays",
@@ -123,7 +123,13 @@ def census() -> dict:
     jax array in the process — including transients in flight — so
     ``unregistered_bytes`` (live minus registered, floored at 0) is an
     upper bound on what the owners table is missing, not an exact leak.
-    ``live_bytes`` is None when the running jax has no ``live_arrays``."""
+    ``live_bytes`` is None when the running jax has no ``live_arrays``.
+
+    ``strict=True`` additionally asserts the registry is not STALE: every
+    registered byte must be backed by a live array, so
+    ``registered_bytes > live_bytes`` proves some owner dropped device
+    buffers without re-noting (the SpeculationCache ``invalidate_after``/
+    ``_trim`` class of bug) and raises ``RuntimeError`` naming the owners."""
     live_bytes = None
     n_live = None
     try:
@@ -140,6 +146,15 @@ def census() -> dict:
     except (ImportError, AttributeError, RuntimeError):
         pass
     registered = total()
+    if strict and live_bytes is not None and registered > live_bytes:
+        owners = ", ".join(
+            f"{k}={v}" for k, v in sorted(_BUFFERS.items()) if v > 0
+        )
+        raise RuntimeError(
+            f"devmem registry is stale: registered_bytes={registered} > "
+            f"live_bytes={live_bytes} — an owner dropped device buffers "
+            f"without re-noting (owners: {owners})"
+        )
     return {
         "registered_bytes": registered,
         "live_bytes": live_bytes,

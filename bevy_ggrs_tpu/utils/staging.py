@@ -36,3 +36,67 @@ def commit(buf, sharding=None):
     # is what makes persistent staging-buffer reuse safe (module docstring)
     x.block_until_ready()
     return x
+
+
+class StagingQueue:
+    """Device-resident input queue: rotate ``depth`` host staging buffers so
+    the transfer-safety block moves off the tick's critical path.
+
+    :func:`commit` above pays one transfer-latency block per upload because a
+    SINGLE persistent buffer is rewritten next tick.  With a rotation of
+    ``depth >= 2`` buffers the invariant relaxes: buffer i is only rewritten
+    ``depth`` acquires later, so its previous upload has had a whole tick (or
+    more) of host work to land — :meth:`acquire` blocks only when it has NOT
+    (counted in ``deferred_blocks`` vs ``landed_free``), and :meth:`commit`
+    starts the copy WITHOUT blocking.  Net effect for the steady 1-frame/
+    update P2P cadence: the packed upload of tick N overlaps tick N+1's
+    session poll/pack instead of stalling tick N, while the census stays at
+    exactly one upload per dispatch."""
+
+    def __init__(self, make_buffer, depth: int = 2):
+        if depth < 2:
+            raise ValueError("StagingQueue needs depth >= 2 buffers")
+        self.buffers = [make_buffer() for _ in range(depth)]
+        self._inflight = [None] * depth  # device array of buffer i's last upload
+        self._idx = 0
+        self.deferred_blocks = 0  # acquires that had to wait on an old upload
+        self.landed_free = 0  # acquires whose old upload had already landed
+
+    @property
+    def nbytes(self) -> int:
+        return sum(getattr(b, "nbytes", 0) for b in self.buffers)
+
+    def acquire(self):
+        """Next host buffer in rotation, safe to rewrite: waits for that
+        buffer's previous in-flight upload iff it has not landed yet."""
+        self._idx = (self._idx + 1) % len(self.buffers)
+        old = self._inflight[self._idx]
+        if old is not None:
+            if _is_ready(old):
+                self.landed_free += 1
+            else:
+                self.deferred_blocks += 1
+                # bgt: ignore[BGT011]: deliberate — same transfer-safety
+                # block as commit(), but only on the rare tick where the
+                # upload from `depth` acquires ago is still in flight
+                old.block_until_ready()
+            self._inflight[self._idx] = None
+        return self.buffers[self._idx]
+
+    def commit(self, view):
+        """Start the upload of ``view`` (a view of the buffer returned by the
+        matching :meth:`acquire`) WITHOUT blocking; returns the device array."""
+        from ..telemetry import devmem
+
+        devmem.note("staging/last_commit", getattr(view, "nbytes", 0))
+        x = jax.device_put(view)
+        self._inflight[self._idx] = x
+        return x
+
+
+def _is_ready(x) -> bool:
+    """True when a device array's async transfer/computation has landed."""
+    try:
+        return bool(x.is_ready())
+    except AttributeError:
+        return False

@@ -47,12 +47,14 @@ _METRIC_RE = re.compile(r"(fps|per_sec|speedup|ticks_per_sec|"
                         r"frames_per_dispatch)")
 _EXCLUDE_RE = re.compile(r"(spread|bytes|pct|entities|depth|reps|lobbies)")
 
-# LOWER-is-better floor metrics: the packed/megastep upload censuses
-# (bench.py stage_uploads) must hold at 1.0 per tick / per flush — an
-# INCREASE past the threshold is the regression (a staging path grew an
-# extra host->device upload or split a dispatch)
+# LOWER-is-better floor metrics: the packed/megastep/input-queue upload
+# censuses (bench.py stage_uploads) must hold at 1.0 per tick / per flush —
+# an INCREASE past the threshold is the regression (a staging path grew an
+# extra host->device upload or split a dispatch) — and the speculation
+# stage's rollback-servicing p99s (bench.py _speculation_service_arm),
+# where an increase means rollback servicing got slower
 _FLOOR_RE = re.compile(r"(uploads_per_tick|dispatches_per_tick|"
-                       r"uploads_per_flush)")
+                       r"uploads_per_flush|rollback_service_p99_ms)")
 
 
 def load_records(dir: str) -> list:

@@ -211,12 +211,12 @@ def test_batched_runner_mixed_source_loads_match_solo():
     load_waves = []
     orig_do_loads = br._do_loads
 
-    def spying_do_loads(wave_ops):
+    def spying_do_loads(wave_ops, *args):
         n = sum(1 for op in wave_ops
                 if op is not None and op.load_frame is not None)
         if n:
             load_waves.append(n)
-        return orig_do_loads(wave_ops)
+        return orig_do_loads(wave_ops, *args)
 
     br._do_loads = spying_do_loads
 
@@ -420,14 +420,14 @@ def test_batched_runner_staggered_p2p_rollback_waves():
     wave_profile = []
     orig_do_loads = br._do_loads
 
-    def spying_do_loads(wave_ops):
+    def spying_do_loads(wave_ops, *args):
         n_load = sum(
             1 for op in wave_ops
             if op is not None and op.load_frame is not None
         )
         if n_load:
             wave_profile.append(n_load)
-        return orig_do_loads(wave_ops)
+        return orig_do_loads(wave_ops, *args)
 
     br._do_loads = spying_do_loads
 

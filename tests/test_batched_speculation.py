@@ -1,0 +1,124 @@
+"""BatchedRunner speculation: draft waves hedge predicted transitions into
+the lanes the active bucket left idle, and a LoadRequest whose corrected run
+was fully hedged is served from the branch cache — bit-identical to a plain
+(speculation-less) batched run of the same script — while partial/unhedged
+corrections fall back to the fused-load miss path.  Plus the strict
+ValueError mode matrix (docs/architecture.md)."""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import BatchedRunner
+from bevy_ggrs_tpu.models import box_game
+from bevy_ggrs_tpu.ops.speculation import SpeculationConfig, pad_candidates
+from bevy_ggrs_tpu.session.requests import LoadRequest, SaveCell, SaveRequest
+from tests.test_speculative_runner import ScriptedSession, adv
+
+
+def _rollback_script(holder, corrected):
+    """Tick 1: save(0) + predicted advance.  Tick 2: the real remote input
+    arrives -> rollback to 0, corrected resim frame, live frame."""
+    RIGHT = box_game.keys_to_input(right=True)
+    predicted = [RIGHT, 0]
+    actual = [RIGHT, corrected]
+
+    def save(f):
+        return SaveRequest(f, SaveCell(holder[0], f))
+
+    tick1 = [save(0), adv(predicted, predicted=True)]
+    tick2 = [LoadRequest(0), adv(actual), save(1),
+             adv(actual, predicted=True)]
+    return [tick1, tick2]
+
+
+def _run_pair(speculation, corrected):
+    """Two lobbies: lobby 0 runs the rollback script, lobby 1 stays idle —
+    its lane is the spare capacity the draft wave fills."""
+    app = box_game.make_app(num_players=2)
+    s0 = ScriptedSession([])
+    s0.script = _rollback_script([s0], corrected)
+    s1 = ScriptedSession([[], []])
+    br = BatchedRunner(app, [s0, s1], speculation=speculation)
+    br.tick()
+    br.tick()
+    return br
+
+
+def _spec(values, depth=4):
+    return SpeculationConfig(
+        candidates_fn=pad_candidates(2, [1], values), depth=depth
+    )
+
+
+def test_batched_cache_hit_matches_plain_run():
+    corrected = box_game.keys_to_input(up=True)
+    br_spec = _run_pair(_spec([corrected]), corrected)
+    br_plain = _run_pair(None, corrected)
+    st = br_spec.stats()["speculation"]
+    assert st["hits"] == 1 and st["misses"] == 0
+    assert st["draft_waves"] >= 1 and st["draft_lanes_filled"] >= 1
+    assert st["cache_served_frames"] == 2  # corrected frame + live frame
+    assert br_spec.frames == br_plain.frames == [2, 0]
+    assert br_spec.lobby_checksum(0) == br_plain.lobby_checksum(0)
+    np.testing.assert_array_equal(
+        np.asarray(br_spec.lobby_world(0).comps["pos"]),
+        np.asarray(br_plain.lobby_world(0).comps["pos"]),
+    )
+    # the re-saved frame-1 checksum (a LazySlice into the branch stack on the
+    # hit path, a batch ref on the plain path) matches bit-exactly
+    assert br_spec.sessions[0].saved[1]() == br_plain.sessions[0].saved[1]()
+
+
+def test_batched_cache_miss_on_unhedged_input_falls_back():
+    corrected = np.uint8(9)  # UP|RIGHT — not among the hedged values
+    br_spec = _run_pair(_spec([0, 1, 2, 3]), corrected)
+    br_plain = _run_pair(None, corrected)
+    st = br_spec.stats()["speculation"]
+    assert st["hits"] == 0 and st["misses"] >= 1
+    assert br_spec.frames == br_plain.frames == [2, 0]
+    assert br_spec.lobby_checksum(0) == br_plain.lobby_checksum(0)
+    assert br_spec.sessions[0].saved[1]() == br_plain.sessions[0].saved[1]()
+
+
+def test_batched_speculation_mode_matrix():
+    app = box_game.make_app(num_players=2)
+    spec = _spec([1])
+    with pytest.raises(ValueError, match="packed=True"):
+        BatchedRunner(app, [ScriptedSession([])], packed=False,
+                      speculation=spec)
+    with pytest.raises(ValueError, match="k_max"):
+        BatchedRunner(app, [ScriptedSession([])], k_max=2,
+                      speculation=_spec([1], depth=8))
+
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from bevy_ggrs_tpu import App, QuantizeStrategy
+    from bevy_ggrs_tpu.snapshot import active_mask, spawn
+
+    qapp = App(num_players=1, capacity=4, input_shape=(),
+               input_dtype=np.uint8)
+    qapp.rollback_component("x", (), jnp.float32,
+                            strategy=QuantizeStrategy(), checksum=True)
+
+    def step(world, ctx):
+        m = active_mask(world)
+        return dataclasses.replace(world, comps={
+            "x": jnp.where(m & world.has["x"], world.comps["x"] + 1.0,
+                           world.comps["x"]),
+        })
+
+    def setup(world):
+        world, _ = spawn(qapp.reg, world, {"x": 0.5})
+        return world
+
+    qapp.set_step(step)
+    qapp.set_setup(setup)
+    with pytest.raises(ValueError, match="identity snapshot"):
+        BatchedRunner(
+            qapp, [ScriptedSession([], num_players=1)],
+            speculation=SpeculationConfig(
+                candidates_fn=pad_candidates(1, [0], [1])
+            ),
+        )

@@ -156,16 +156,22 @@ def test_packed_canonical_bit_identical():
     assert st["host_uploads"] == st["device_dispatches"]
 
 
-def test_packed_falls_back_without_packed_program():
-    # canonical_branches mode ships no packed program: packed=True must
-    # degrade to the three-upload path, not crash
+def test_packed_mode_matrix_without_packed_program():
+    # canonical_branches mode ships no packed program.  The default
+    # (packed=None) degrades to the three-upload path; an EXPLICIT
+    # packed=True raises the mode-matrix ValueError instead of silently
+    # excluding itself (docs/architecture.md)
+    import pytest
+
     app = stress.make_app(64, capacity=64)
     app.canonical_depth = 8
     app.canonical_branches = 4
     assert app.packed_resim_fn is None
-    runner = _synctest_driver(lambda: app, packed=True, ticks=12)
+    runner = _synctest_driver(lambda: app, packed=None, ticks=12)
     assert runner.packed is False
     assert runner.stats()["host_uploads"] > 0  # census still counts
+    with pytest.raises(ValueError, match="packed program"):
+        _synctest_driver(lambda: app, packed=True, ticks=0)
 
 
 # -------------------------------------------------- batched / sharded waves

@@ -1,0 +1,167 @@
+"""Rollback SERVICING through the speculation seam (runner._service_rollback):
+repeated hedged rollbacks under speculation + pipeline + packed must stay
+bit-identical to the plain sync unpacked driver; the SyncTest oracle (all
+inputs CONFIRMED -> drafts never fire) exercises the all-miss path and the
+``rollback_service_ms{path=miss}`` histogram; ``invalidate_after`` keeps the
+cache sound (and the devmem registry reconciled) across a mid-speculation
+disconnect rollback; plus the solo rows of the strict mode matrix and the
+device-resident input-queue satellite's bit-equality + census."""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import GgrsRunner, SyncTestSession, telemetry
+from bevy_ggrs_tpu.models import box_game, fixed_point
+from bevy_ggrs_tpu.ops.speculation import SpeculationConfig, pad_candidates
+from bevy_ggrs_tpu.session.requests import (
+    LoadRequest,
+    RollbackCause,
+    SaveCell,
+    SaveRequest,
+)
+from bevy_ggrs_tpu.snapshot.checksum import checksum_to_int
+from tests.test_packed import _assert_bit_identical, _synctest_driver
+from tests.test_speculative_runner import ScriptedSession, adv
+
+RIGHT = box_game.keys_to_input(right=True)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _save(session, f):
+    return SaveRequest(f, SaveCell(session, f))
+
+
+def make_rounds_script(session, correcteds):
+    """R rounds of (predicted advance -> corrected rollback): every odd tick
+    rolls back two frames and re-advances with the real remote input."""
+    ticks = []
+    f = 0
+    for corrected in correcteds:
+        actual = [RIGHT, corrected]
+        ticks.append([_save(session, f), adv([RIGHT, 0], predicted=True)])
+        ticks.append([
+            LoadRequest(f), adv(actual), _save(session, f + 1),
+            adv(actual, predicted=True),
+        ])
+        f += 2
+    return ticks
+
+
+def _run_rounds(speculation, correcteds, **kw):
+    app = box_game.make_app(num_players=2)
+    session = ScriptedSession([])
+    session.script = make_rounds_script(session, correcteds)
+    runner = GgrsRunner(app, session, speculation=speculation, **kw)
+    for _ in range(2 * len(correcteds)):
+        runner.tick()
+    return runner
+
+
+def test_repeated_hedged_rollbacks_bit_identical_to_sync_unpacked():
+    correcteds = [1, 2, 9, 5]
+    spec = SpeculationConfig(
+        candidates_fn=pad_candidates(2, [1], list(range(16))), depth=4
+    )
+    r_spec = _run_rounds(spec, correcteds, pipeline=True, packed=True)
+    r_plain = _run_rounds(None, correcteds, pipeline=False, packed=False)
+    assert r_spec.spec_cache.hits == len(correcteds)
+    assert r_spec.frame == r_plain.frame == 2 * len(correcteds)
+    np.testing.assert_array_equal(
+        np.asarray(r_spec.world.comps["pos"]),
+        np.asarray(r_plain.world.comps["pos"]),
+    )
+    assert checksum_to_int(r_spec._world_checksum) == checksum_to_int(
+        r_plain._world_checksum
+    )
+    for f in sorted(r_plain.session.saved):
+        assert r_spec.session.saved[f]() == r_plain.session.saved[f]()
+
+
+def test_synctest_oracle_with_speculation_is_all_miss():
+    # SyncTest emits CONFIRMED statuses only, so drafts never fire — every
+    # structural-resim LoadRequest goes through lookup (miss) and the miss
+    # servicing path, and the oracle proves it restores bit-exactly
+    telemetry.enable()
+    spec = SpeculationConfig(
+        candidates_fn=pad_candidates(2, [1], list(range(16))), depth=4
+    )
+    r_spec = _synctest_driver(
+        lambda: box_game.make_app(num_players=2), packed=True,
+        speculation=spec,
+    )
+    r_plain = _synctest_driver(
+        lambda: box_game.make_app(num_players=2), packed=False
+    )
+    _assert_bit_identical(r_spec, r_plain)
+    assert r_spec.spec_cache.hits == 0
+    assert r_spec.spec_cache.misses > 0
+    h = telemetry.registry().histogram("rollback_service_ms")
+    assert h.percentile(0.5, path="miss") is not None
+    assert h.percentile(0.5, path="hit") is None
+
+
+def test_invalidate_after_mid_speculation_disconnect():
+    app = box_game.make_app(num_players=2)
+    session = ScriptedSession([])
+    actual = [RIGHT, 7]  # NOT hedged below -> the disconnect load misses
+    session.script = [
+        [_save(session, 0), adv([RIGHT, 0], predicted=True)],
+        [_save(session, 1), adv([RIGHT, 0], predicted=True)],
+        [
+            LoadRequest(0, cause=RollbackCause(handle=1, lateness=2,
+                                               kind="disconnect")),
+            adv(actual), _save(session, 1), adv(actual), _save(session, 2),
+            adv(actual),
+        ],
+    ]
+    spec = SpeculationConfig(
+        candidates_fn=pad_candidates(2, [1], [0, 1, 2, 3]), depth=4
+    )
+    runner = GgrsRunner(app, session, speculation=spec)
+    runner.tick()
+    runner.tick()
+    cache = runner.spec_cache
+    assert set(cache._cache) == {0, 1}  # one branch set per predicted tick
+    runner.tick()  # disconnect-consensus rollback to 0
+    # entries hedged from the now-superseded frame-1 prediction are gone;
+    # the frame-0 set (base state unchanged by the load) survives
+    assert set(cache._cache) == {0}
+    assert cache.misses >= 1
+    # devmem row tracks the post-invalidation footprint exactly, and the
+    # registry reconciles against live arrays (satellite: no stale bytes)
+    from bevy_ggrs_tpu.telemetry import devmem
+
+    assert devmem.snapshot()[cache._devmem_owner] == cache.cached_bytes
+    devmem.census(strict=True)
+
+
+def test_solo_mode_matrix():
+    app = box_game.make_app(num_players=2)
+    sess = SyncTestSession(num_players=2)
+    with pytest.raises(ValueError, match="input_queue"):
+        GgrsRunner(app, sess, packed=False, input_queue=True)
+    spec = SpeculationConfig(candidates_fn=pad_candidates(2, [1], [1]))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        GgrsRunner(box_game.make_app(num_players=2),
+                   SyncTestSession(num_players=2),
+                   megastep=True, speculation=spec)
+
+
+def test_input_queue_bit_identical_and_census():
+    q = _synctest_driver(fixed_point.make_app, packed=True, input_queue=True)
+    plain = _synctest_driver(fixed_point.make_app, packed=False)
+    _assert_bit_identical(q, plain)
+    st = q.stats()
+    assert st["input_queue"] is True
+    # the steady census is untouched: one upload per fused dispatch, the
+    # rotation only moves the transfer-safety block off the critical path
+    assert st["host_uploads"] == st["device_dispatches"]
+    assert st["staging_deferred_blocks"] + st["staging_landed_free"] > 0
