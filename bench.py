@@ -1570,6 +1570,265 @@ def stage_trace():
     }
 
 
+FLEET_TARGET = 1500
+FLEET_ENTITIES = 256
+FLEET_LOBBIES = 3
+FLEET_CAPACITY = 3  # per worker; 2 workers
+
+
+def stage_fleet():
+    """Fleet control plane end-to-end: a real 2-worker fleet (separate
+    processes, loopback UDP), synthetic lobbies, a live migration, a
+    SIGKILL failover, and a wire admission probe.
+
+    The stage runs a :class:`FleetScheduler` in-process and spawns two
+    ``scripts/fleet_worker.py`` subprocesses.  It places ``FLEET_LOBBIES``
+    synthetic stress_soa lobbies, fills the remaining slots with inert
+    external-mode lobbies to probe admission, live-migrates one lobby
+    between workers at ~1/3 of its run, then SIGKILLs the busiest worker at
+    mid-game and lets the scheduler fail its lobbies over from their last
+    confirmed shipped checkpoints.  Afterwards every lobby's final checksum
+    is compared against an in-process control run of the same spec (whole
+    stage pinned to CPU: a checksum comparison across different backends
+    would compare different float programs, not the fleet).
+
+    HARD GATES (raise -> nonzero exit):
+
+    1. zero desyncs — every lobby's wire-reported final checksum equals
+       its unmigrated in-process control, bit for bit, despite one lobby
+       migrating live and others failing over from checkpoints;
+    2. >= 1 live migration completed (``outcome=ok``) with its downtime
+       measured into ``migration_downtime_ms``;
+    3. >= 1 failover resumed from the last CONFIRMED shipped frame after
+       the SIGKILL (``outcome=failover``, resume frame > 0);
+    4. admission control is wire-visible — a SUBMIT into a full fleet
+       comes back as a REJECT datagram with reason ``capacity``.
+
+    ``BGT_BENCH_SMOKE=1`` shrinks frames/entities; every gate stays
+    armed."""
+    # pin the WHOLE stage (scheduler, workers, control resims) to CPU
+    # before any jax import: gate 1 compares bits across processes, which
+    # is only meaningful when both sides run the same backend
+    os.environ["BGT_PLATFORM"] = "cpu"
+    from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    jax = _stage_setup()
+    import threading
+
+    from bevy_ggrs_tpu import telemetry
+    from bevy_ggrs_tpu.fleet import (
+        FleetClient, FleetScheduler, LobbySim, LobbySpec, checksum_hex,
+    )
+
+    smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
+    target = 300 if smoke else FLEET_TARGET
+    entities = 32 if smoke else FLEET_ENTITIES
+    wait_s = 180 if smoke else 420
+
+    telemetry.enable()
+    # generous timeout: even with interleaved heartbeats, one first-step
+    # canonical compile on a loaded CI host can stall a worker for seconds
+    sched = FleetScheduler(worker_timeout_s=8.0)
+    port = sched.local_addr[1]
+    procs = {}
+
+    def spawn(wid):
+        env = dict(os.environ)
+        env["BGT_PLATFORM"] = "cpu"
+        # paced to realtime cadence: an unpaced CPU sim clears the whole
+        # horizon between two heartbeats, and every phase below (migrate at
+        # ~1/3, SIGKILL mid-game) depends on lobbies actually being mid-game
+        procs[wid] = subprocess.Popen(
+            [sys.executable, os.path.join(ROOT, "scripts", "fleet_worker.py"),
+             "--scheduler", f"127.0.0.1:{port}", "--worker-id", wid,
+             "--capacity", str(FLEET_CAPACITY), "--ckpt-every", "40",
+             "--pace-fps", "240"],
+            cwd=ROOT, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    def pump_until(cond, timeout_s, what):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            sched.poll()
+            if cond():
+                return
+            time.sleep(0.002)
+        raise RuntimeError(f"fleet gate: timed out waiting for {what} "
+                           f"(snapshot: {sched.snapshot()['lobbies']})")
+
+    try:
+        spawn("wA")
+        spawn("wB")
+        pump_until(lambda: len(sched.workers) == 2, wait_s,
+                   "2 workers to register")
+
+        # the last lobby runs 4x longer so it is provably still mid-game
+        # when its worker gets SIGKILLed — otherwise fast CPU sims could
+        # finish everything before the kill and the failover gate would
+        # pass vacuously
+        specs = [
+            LobbySpec(lobby_id=f"fl{i}", app="stress_soa",
+                      entities=entities, seed=i,
+                      target_frames=target * (4 if i == FLEET_LOBBIES - 1
+                                              else 1))
+            for i in range(FLEET_LOBBIES)
+        ]
+        for spec in specs:
+            ok, who = sched.submit(spec)
+            if not ok:
+                raise RuntimeError(
+                    f"fleet gate: lobby {spec.lobby_id} rejected at "
+                    f"placement time ({who})"
+                )
+        pump_until(
+            lambda: all(sched.lobbies[s.lobby_id].state in ("running",
+                                                            "done")
+                        for s in specs),
+            wait_s, "all lobbies placed and running",
+        )
+
+        # admission probe: fill every remaining slot with inert external-
+        # mode lobbies (no queued inputs -> zero sim cost), then a SUBMIT
+        # over the wire must come back REJECT(capacity)
+        fleet_slots = 2 * FLEET_CAPACITY
+        fillers = [f"fill{i}" for i in range(fleet_slots - FLEET_LOBBIES)]
+        for fid in fillers:
+            ok, who = sched.submit(LobbySpec(
+                lobby_id=fid, app="stress_soa", entities=16,
+                target_frames=1_000_000, input_mode="external",
+            ))
+            if not ok:
+                raise RuntimeError(f"fleet gate: filler {fid} rejected "
+                                   f"({who}) before the fleet was full")
+        cli = FleetClient(sched.local_addr)
+        verdict = {}
+
+        def ask():
+            verdict["worker"] = cli.submit(
+                LobbySpec(lobby_id="overflow", app="stress_soa",
+                          entities=16), timeout_s=30,
+            )
+            verdict["reason"] = cli.last_reject
+
+        t = threading.Thread(target=ask)
+        t.start()
+        while t.is_alive():
+            sched.poll()
+            time.sleep(0.002)
+        t.join()
+        cli.close()
+        if verdict["worker"] is not None or verdict["reason"] != "capacity":
+            raise RuntimeError(
+                "fleet gate: overflow SUBMIT into a full fleet must be "
+                "rejected on the wire with reason 'capacity'; got "
+                f"worker={verdict['worker']!r} reason={verdict['reason']!r}"
+            )
+        for fid in fillers:
+            sched.drop(fid)
+
+        # live migration at ~1/3 of the short horizon.  The LONG lobby is
+        # the one migrated: scheduler-side frame knowledge is heartbeat-
+        # lagged, and a post-compile CPU sim can clear a short lobby's
+        # whole horizon between two heartbeats — the 4x runway guarantees
+        # the migration lands mid-game
+        mig = specs[-1].lobby_id
+        rec = sched.lobbies[mig]
+        pump_until(lambda: rec.frame >= target // 3, wait_s,
+                   f"{mig} to reach frame {target // 3}")
+        src = rec.worker_id
+        if not sched.migrate(mig):
+            raise RuntimeError("fleet gate: migrate() found no destination")
+        pump_until(
+            lambda: rec.state == "running" and rec.worker_id != src,
+            wait_s, f"{mig} to finish migrating off {src}",
+        )
+        if not any(e["event"] == "migrate_ok" for e in sched.events):
+            raise RuntimeError(
+                "fleet gate: no completed live migration (migrate_ok); "
+                f"events: {[e['event'] for e in sched.events]}"
+            )
+
+        # failover: SIGKILL the worker hosting the long lobby once a
+        # confirmed checkpoint for it is in scheduler hands and the game
+        # is provably still in progress
+        long_rec = sched.lobbies[specs[-1].lobby_id]
+        pump_until(
+            lambda: long_rec.state == "running"
+            and long_rec.ckpt_blob is not None,
+            wait_s, "a confirmed checkpoint for the long lobby",
+        )
+        if long_rec.frame >= specs[-1].target_frames:
+            raise RuntimeError(
+                "fleet gate: the long lobby finished before the kill — "
+                "failover was never exercised"
+            )
+        victim = long_rec.worker_id
+        procs[victim].kill()
+        procs[victim].wait()
+
+        pump_until(
+            lambda: all(sched.lobbies[s.lobby_id].state == "done"
+                        for s in specs),
+            wait_s, "all lobbies to finish",
+        )
+
+        failovers = [e for e in sched.events if e["event"] == "failover"]
+        if not failovers:
+            raise RuntimeError(
+                "fleet gate: worker was SIGKILLed but no lobby failed "
+                f"over; events: {[e['event'] for e in sched.events]}"
+            )
+        bad = [e for e in failovers if e.get("frame", 0) <= 0]
+        if bad:
+            raise RuntimeError(
+                "fleet gate: failover resumed from frame 0 — the "
+                f"confirmed-checkpoint path was not used: {bad}"
+            )
+
+        # gate 1: zero desyncs vs in-process controls
+        desyncs = []
+        for spec in specs:
+            control = LobbySim(spec)
+            control.run_to(spec.target_frames)
+            want = checksum_hex(control.checksum())
+            got = sched.lobbies[spec.lobby_id].final_checksum
+            if got != want:
+                desyncs.append((spec.lobby_id, got, want))
+        if desyncs:
+            raise RuntimeError(
+                f"fleet gate: DESYNC — migrated/failed-over lobbies do not "
+                f"match their unmigrated controls: {desyncs}"
+            )
+
+        mig_events = [e for e in sched.events if e["event"] == "migrate_ok"]
+        downtime = mig_events[-1]["downtime_ms"] if mig_events else None
+        reject_series = (telemetry.summary()["metrics"]
+                         .get("admission_rejects_total", {})
+                         .get("series", {}))
+        return {
+            "fleet_workers_spawned": 2,
+            "fleet_lobbies": FLEET_LOBBIES,
+            "fleet_target_frames": target,
+            "fleet_entities": entities,
+            "fleet_migrations_ok": len(mig_events),
+            "fleet_migration_downtime_ms": downtime,
+            "fleet_failovers": len(failovers),
+            "fleet_failover_frames": [e.get("frame") for e in failovers],
+            "fleet_admission_rejects": reject_series,
+            "fleet_desyncs": 0,
+            "fleet_events": [e["event"] for e in sched.events],
+            "platform": jax.devices()[0].platform,
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        sched.close()
+
+
 STAGES = {
     # headline-first order — a tunnel death after stage k voids nothing
     # before it (round-3 postmortem, VERDICT "what's weak" #1)
@@ -1586,6 +1845,7 @@ STAGES = {
     "uploads": (stage_uploads, 420),
     "netstats": (stage_netstats, 420),
     "trace": (stage_trace, 420),
+    "fleet": (stage_fleet, 900),
 }
 
 
@@ -1853,6 +2113,28 @@ def orchestrate():
                 "netstats_lateness_p95_frames"),
             "qos": merged.get("netstats_qos"),
         },
+        "fleet": {
+            "workers_spawned": merged.get("fleet_workers_spawned"),
+            "lobbies": merged.get("fleet_lobbies"),
+            "target_frames": merged.get("fleet_target_frames"),
+            "entities": merged.get("fleet_entities"),
+            "migrations_ok": merged.get("fleet_migrations_ok"),
+            "migration_downtime_ms": merged.get(
+                "fleet_migration_downtime_ms"),
+            "failovers": merged.get("fleet_failovers"),
+            "failover_frames": merged.get("fleet_failover_frames"),
+            "admission_rejects": merged.get("fleet_admission_rejects"),
+            "desyncs": merged.get("fleet_desyncs"),
+            "events": merged.get("fleet_events"),
+        },
+        # every per-stage spread in one place so the history gate (and a
+        # human reading BENCH_rXX.json) can tell CPU-fallback run-to-run
+        # noise from a real regression: a delta inside the larger of the
+        # two runs' spreads is noise, not signal
+        "stage_spreads": {
+            k: v for k, v in merged.items()
+            if "spread" in k and v is not None
+        },
         "platform": headline_platform,
         "stage_platforms": stage_platforms,
         "stage_errors": errors or None,
@@ -1866,13 +2148,14 @@ def orchestrate():
 
 def smoke():
     """CI smoke: the batched + sharded + netstats + uploads + speculation +
-    trace stages only, 1 rep, small iter counts — seconds, not minutes —
-    with every hard gate fully armed (a dispatch-count regression in either
-    executor, a broken rollback-cause invariant, a sampler-cost regression,
-    an extra host->device upload on the packed/megastep/input-queue paths,
-    a hit-path rollback-servicing p99 that is not >=5x below the miss path,
-    a malformed Chrome trace, or trace-recording overhead past 2% fails
-    this run).
+    trace + fleet stages only, 1 rep, small iter counts — seconds, not
+    minutes — with every hard gate fully armed (a dispatch-count regression
+    in either executor, a broken rollback-cause invariant, a sampler-cost
+    regression, an extra host->device upload on the packed/megastep/
+    input-queue paths, a hit-path rollback-servicing p99 that is not >=5x
+    below the miss path, a malformed Chrome trace, trace-recording overhead
+    past 2%, a fleet desync after live migration or SIGKILL failover, or a
+    non-wire-visible admission reject fails this run).
     The sharded stage runs under forced 8-virtual-device CPU so the mesh
     path is exercised even on single-chip hosts; netstats runs on CPU (its
     gates are host-loop properties, not device throughput).  Wired into
@@ -1924,6 +2207,13 @@ def smoke():
     if trace is None:
         print(f"bench smoke FAILED (trace stage): {err}", file=sys.stderr)
         sys.exit(1)
+    fleet, err = _run_stage(
+        "fleet", timeout_s=540, force_cpu=True,
+        extra_env={"BGT_BENCH_SMOKE": "1"},
+    )
+    if fleet is None:
+        print(f"bench smoke FAILED (fleet stage): {err}", file=sys.stderr)
+        sys.exit(1)
     print(json.dumps({"smoke": "ok", **result,
                       "sharded": {k: v for k, v in sharded.items()
                                   if k != "platform"},
@@ -1934,6 +2224,8 @@ def smoke():
                       "speculation": {k: v for k, v in speculation.items()
                                       if k != "platform"},
                       "trace": {k: v for k, v in trace.items()
+                                if k != "platform"},
+                      "fleet": {k: v for k, v in fleet.items()
                                 if k != "platform"}}))
 
 
@@ -1942,8 +2234,8 @@ def main():
     ap.add_argument("--stage", choices=sorted(STAGES), default=None)
     ap.add_argument("--smoke", action="store_true",
                     help="batched + sharded + netstats + uploads + "
-                         "speculation + trace stages only, 1 rep, all "
-                         "hard gates armed")
+                         "speculation + trace + fleet stages only, 1 rep, "
+                         "all hard gates armed")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="with --stage trace: also write the validated "
                          "Chrome-trace JSON here (load in ui.perfetto.dev)")

@@ -1,0 +1,50 @@
+"""Fleet layer: multi-host matchmaking, placement, migration, failover.
+
+The packages below promote the single-process room server
+(session/room.py) into a fleet control plane:
+
+- :mod:`.protocol` — the wire messages (riding the room framing) between
+  scheduler, workers, and clients, plus chunked checkpoint transfer.
+- :mod:`.lobby` — the unit of work: a deterministic, checkpointable
+  :class:`~.lobby.LobbySim` built from canonical-depth apps so migration
+  cannot change bits.
+- :mod:`.worker` — one host process: registers, heartbeats, runs placed
+  lobbies, drains/ships/restores checkpoints.
+- :mod:`.scheduler` — the matchmaker: QoS/bytes-aware greedy placement,
+  wire-visible admission control, drain-at-barrier live migration, and
+  heartbeat-timeout failover from last-confirmed checkpoints.
+
+See docs/architecture.md "Fleet scheduling & migration" for the lifecycle
+diagrams and docs/observability.md for the ``fleet_*`` metric families."""
+
+from .lobby import (
+    APP_CATALOG,
+    LOBBY_CHUNK,
+    LobbySim,
+    LobbySpec,
+    checksum_hex,
+    spec_est_bytes,
+    synthetic_inputs,
+)
+from .protocol import ChunkAssembler, Msg, chunk_checkpoint, decode
+from .scheduler import FleetClient, FleetScheduler, LobbyRecord, WorkerInfo
+from .worker import FleetWorker
+
+__all__ = [
+    "APP_CATALOG",
+    "LOBBY_CHUNK",
+    "LobbySim",
+    "LobbySpec",
+    "checksum_hex",
+    "spec_est_bytes",
+    "synthetic_inputs",
+    "ChunkAssembler",
+    "Msg",
+    "chunk_checkpoint",
+    "decode",
+    "FleetClient",
+    "FleetScheduler",
+    "LobbyRecord",
+    "WorkerInfo",
+    "FleetWorker",
+]

@@ -1,0 +1,300 @@
+"""The fleet's unit of work: a deterministic, checkpointable lobby sim.
+
+A :class:`LobbySim` is a server-side lockstep lobby — app + world + frame +
+an input queue — that a fleet worker hosts, advances in chunks, and can
+freeze into a single checkpoint artifact (world + frame + the unsimulated
+input-queue tail, via :mod:`..snapshot.persist`) for live migration or
+failover.  Determinism contract: given the same :class:`LobbySpec` and the
+same submitted inputs, a lobby produces bit-identical checksums at every
+frame on every host, whatever the chunking of its advances — the catalog
+apps are built with ``canonical_depth`` so every advance runs through ONE
+compiled program regardless of how a migration split the frame sequence
+(docs/determinism.md "One program to advance them all").
+
+Input modes:
+
+- ``synthetic`` — inputs are a pure function of ``(spec.seed, frame)``
+  (counter-based seeding, no sequential RNG state to checkpoint); the
+  fleet bench drives thousands of frames this way and any host can
+  regenerate any frame's inputs after a failover.
+- ``external`` — inputs arrive via :meth:`LobbySim.submit_input`; the sim
+  only advances through frames whose inputs are queued, and the
+  *unsimulated tail rides the checkpoint* — a migrated lobby must consume
+  exactly the inputs its source had queued, or it desyncs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..snapshot.checksum import checksum_to_int
+from ..snapshot.persist import load_checkpoint, save_world, schema_digest
+
+# default per-advance chunk == the canonical program depth of catalog apps:
+# one dispatch per chunk, and the padded program keeps partial chunks
+# (barrier stops, target stops) bit-identical to full ones
+LOBBY_CHUNK = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class LobbySpec:
+    """Everything needed to (re)build a lobby anywhere in the fleet.
+
+    Travels as JSON in PLACE/RESUME/SUBMIT datagrams; ``est_bytes`` is the
+    admission-control sizing hint (device-resident bytes the lobby will
+    pin), defaulted from the app's world size when 0."""
+
+    lobby_id: str
+    app: str = "stress_soa"
+    entities: int = 256
+    players: int = 2
+    seed: int = 0
+    target_frames: int = 600
+    input_mode: str = "synthetic"  # or "external"
+    est_bytes: int = 0
+
+    def to_json(self) -> dict:
+        """The wire form (plain dict for protocol JSON tails)."""
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "LobbySpec":
+        """Rebuild from the wire form; unknown keys are ignored (forward
+        compatibility across fleet versions)."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in obj.items() if k in fields})
+
+
+def _make_stress_soa(spec: LobbySpec):
+    from ..models import stress_soa
+
+    return stress_soa.make_app(
+        spec.entities, seed=spec.seed, canonical_depth=LOBBY_CHUNK
+    )
+
+
+def _make_box_game(spec: LobbySpec):
+    from ..models import box_game
+
+    return box_game.make_app(
+        num_players=spec.players, canonical_depth=LOBBY_CHUNK
+    )
+
+
+# app catalog: name -> App factory.  Every entry MUST pass canonical_depth
+# (see module docstring) — a per-length-program app would drift across
+# migration chunk boundaries.
+APP_CATALOG: Dict[str, Callable[[LobbySpec], object]] = {
+    "stress_soa": _make_stress_soa,
+    "box_game": _make_box_game,
+}
+
+
+def synthetic_inputs(spec: LobbySpec, app, frame: int) -> np.ndarray:
+    """The synthetic per-frame input row ``[players, *input_shape]``.
+
+    Counter-based seeding — a pure function of (seed, frame) — so a resumed
+    or failed-over lobby regenerates the identical stream with no RNG state
+    in the checkpoint."""
+    rng = np.random.default_rng((spec.seed, frame))
+    shape = (app.num_players, *app.input_shape)
+    if np.issubdtype(app.input_dtype, np.integer):
+        return rng.integers(0, 16, size=shape).astype(app.input_dtype)
+    return rng.uniform(-1, 1, size=shape).astype(app.input_dtype)
+
+
+class LobbySim:
+    """One hosted lobby: app + world + frame + input queue, checkpointable.
+
+    Drive with :meth:`step`; freeze with :meth:`checkpoint_bytes`; thaw on
+    another host with :meth:`restore`.  ``frame`` is the last simulated
+    (and, lockstep, confirmed) frame; the queue holds inputs for frames
+    > ``frame``."""
+
+    def __init__(self, spec: LobbySpec, _restored=None):
+        if spec.app not in APP_CATALOG:
+            raise ValueError(
+                f"unknown lobby app {spec.app!r}; catalog: "
+                f"{sorted(APP_CATALOG)}"
+            )
+        if spec.input_mode not in ("synthetic", "external"):
+            raise ValueError("input_mode must be 'synthetic' or 'external'")
+        self.spec = spec
+        self.app = APP_CATALOG[spec.app](spec)
+        # pending inputs: frame -> [players, *input_shape] (external mode;
+        # synthetic mode generates on demand)
+        self.pending: Dict[int, np.ndarray] = {}
+        if _restored is not None:
+            self.world, self.frame = _restored
+        else:
+            self.world = self.app.init_state()
+            self.frame = 0
+        self._status_row = np.zeros((self.app.num_players,), np.int8)
+        self._last_checksum: Optional[int] = None
+
+    # -- inputs ------------------------------------------------------------
+
+    def submit_input(self, frame: int, row) -> None:
+        """Queue the input row for ``frame`` (external mode).  Frames at or
+        below the simulated frame are already history — rejecting them here
+        is what makes the checkpoint tail authoritative."""
+        if self.spec.input_mode != "external":
+            raise ValueError("submit_input on a synthetic-input lobby")
+        if frame <= self.frame:
+            raise ValueError(
+                f"input for frame {frame} but lobby already simulated "
+                f"frame {self.frame}"
+            )
+        row = np.asarray(row, self.app.input_dtype)
+        want = (self.app.num_players, *self.app.input_shape)
+        if row.shape != want:
+            raise ValueError(f"input row shape {row.shape} != {want}")
+        self.pending[frame] = row
+
+    def _input_row(self, frame: int) -> Optional[np.ndarray]:
+        if self.spec.input_mode == "synthetic":
+            got = self.pending.pop(frame, None)
+            if got is not None:
+                return got
+            return synthetic_inputs(self.spec, self.app, frame)
+        return self.pending.pop(frame, None)
+
+    def ready_frames(self, limit: int) -> int:
+        """How many frames past ``self.frame`` could advance right now
+        (bounded by ``limit``, the target frame, and — external mode — the
+        contiguous queued prefix)."""
+        room = min(limit, self.spec.target_frames - self.frame)
+        if room <= 0:
+            return 0
+        if self.spec.input_mode == "synthetic":
+            return room
+        n = 0
+        while n < room and (self.frame + n + 1) in self.pending:
+            n += 1
+        return n
+
+    # -- advancing ---------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        """True once the lobby simulated its target frame."""
+        return self.frame >= self.spec.target_frames
+
+    def step(self, max_frames: int = LOBBY_CHUNK) -> int:
+        """Advance up to ``max_frames`` frames in one chunked dispatch;
+        returns how many frames actually advanced.  The last chunk's final
+        checksum is retained for :meth:`checksum`."""
+        k = self.ready_frames(max_frames)
+        if k <= 0:
+            return 0
+        rows = []
+        for i in range(1, k + 1):
+            row = self._input_row(self.frame + i)
+            assert row is not None  # ready_frames counted it
+            rows.append(row)
+        inputs_seq = np.stack(rows)
+        status_seq = np.broadcast_to(
+            self._status_row, (k, self.app.num_players)
+        )
+        final, _stacked, checks = self.app.resim_fn(
+            self.world, inputs_seq, np.ascontiguousarray(status_seq),
+            self.frame,
+        )
+        self.world = final
+        self.frame += k
+        self._last_checksum = checksum_to_int(checks[k - 1])
+        return k
+
+    def run_to(self, frame: int, chunk: int = LOBBY_CHUNK) -> None:
+        """Advance to exactly ``frame`` (synthetic mode / tests)."""
+        while self.frame < min(frame, self.spec.target_frames):
+            if self.step(min(chunk, frame - self.frame)) == 0:
+                break
+
+    def checksum(self) -> int:
+        """The 64-bit world checksum at the current frame (forces a device
+        readback — control-plane use, not hot-loop)."""
+        cs = self.app.checksum_fn(self.world)
+        return checksum_to_int(cs)
+
+    # -- checkpoint / restore ---------------------------------------------
+
+    def checkpoint_bytes(self) -> bytes:
+        """Freeze world + frame + the unsimulated input-queue tail into one
+        npz blob (the migration/failover artifact)."""
+        tail = sorted(f for f in self.pending if f > self.frame)
+        extras = {}
+        if tail:
+            extras["tail_frames"] = np.asarray(tail, np.int64)
+            extras["tail_inputs"] = np.stack(
+                [self.pending[f] for f in tail]
+            )
+        buf = io.BytesIO()
+        save_world(buf, self.app.reg, self.world, frame=self.frame,
+                   extras=extras)
+        return buf.getvalue()
+
+    @classmethod
+    def restore(cls, spec: LobbySpec, blob: bytes) -> "LobbySim":
+        """Thaw a checkpoint into a fresh sim (schema-checked, strict
+        dtypes — see snapshot/persist.py) and re-queue its input tail."""
+        tmp = cls(spec)  # builds the app/registry the checkpoint must match
+        ck = load_checkpoint(io.BytesIO(blob), tmp.app.reg)
+        sim = cls(spec, _restored=(ck.world, ck.frame))
+        frames = ck.extras.get("tail_frames")
+        if frames is not None:
+            inputs = ck.extras["tail_inputs"]
+            for i, f in enumerate(frames.tolist()):
+                sim.pending[int(f)] = np.asarray(
+                    inputs[i], sim.app.input_dtype
+                )
+        return sim
+
+    def state_digest(self) -> str:
+        """Registry schema digest (control-plane sanity: a RESUME against a
+        worker running a different build fails fast, by name)."""
+        return schema_digest(self.app.reg)
+
+    def est_bytes(self) -> int:
+        """Device-resident footprint estimate for admission control: the
+        world pytree's nbytes (canonical programs keep one resident world
+        per lobby on the worker)."""
+        import jax
+
+        return int(sum(
+            np.asarray(x).nbytes for x in jax.tree.leaves(self.world)
+        ))
+
+
+def spec_est_bytes(spec: LobbySpec) -> int:
+    """Admission sizing WITHOUT building device state: world bytes computed
+    from the registry's template shapes (host-side numpy only)."""
+    if spec.est_bytes:
+        return int(spec.est_bytes)
+    app = APP_CATALOG[spec.app](spec)
+    import jax
+
+    template = app.reg.init_state()
+    return int(sum(
+        np.asarray(x).nbytes for x in jax.tree.leaves(template)
+    ))
+
+
+def spec_to_wire(spec: LobbySpec) -> dict:
+    """Spec -> wire dict (alias of :meth:`LobbySpec.to_json`, kept as a
+    module function for symmetry with :func:`spec_from_wire`)."""
+    return spec.to_json()
+
+
+def spec_from_wire(obj: dict) -> LobbySpec:
+    """Wire dict -> spec (lenient; see :meth:`LobbySpec.from_json`)."""
+    return LobbySpec.from_json(obj)
+
+
+def checksum_hex(value: int) -> str:
+    """64-bit checksum -> fixed-width hex for DONE datagrams."""
+    return f"{value & 0xFFFFFFFFFFFFFFFF:016x}"

@@ -45,11 +45,19 @@ def step(world, ctx):
 
 
 def make_app(n_entities: int = 10_000, capacity: int | None = None,
-             fps: int = 60, checksum: bool = True, seed: int = 0) -> App:
-    """Build the scalar-column benchmark App with n_entities pre-spawned."""
+             fps: int = 60, checksum: bool = True, seed: int = 0,
+             canonical_depth: int | None = None) -> App:
+    """Build the scalar-column benchmark App with n_entities pre-spawned.
+
+    Pass ``canonical_depth`` for cross-host bit-determinism of the float
+    physics: the fleet lobby catalog (fleet/lobby.py) needs every advance —
+    whatever its chunking before/after a migration — to run through ONE
+    compiled program (docs/determinism.md "One program to advance them
+    all")."""
     capacity = capacity or n_entities
     app = App(num_players=2, capacity=capacity, fps=fps,
-              input_shape=(), input_dtype=np.uint8, seed=seed)
+              input_shape=(), input_dtype=np.uint8, seed=seed,
+              canonical_depth=canonical_depth)
     for name in _COLS:
         app.rollback_component(name, (), jnp.float32, checksum=checksum)
     app.set_step(step)

@@ -4,43 +4,155 @@ The reference keeps checkpoints only in memory (its ring IS the rollback
 feature; "no disk persistence anywhere", SURVEY §5.4).  Here a WorldState is
 a flat pytree of arrays, so durable checkpoints are nearly free; combined
 with :mod:`..session.replay` they enable resume, golden-state regression
-tests, and desync bisection across builds."""
+tests, desync bisection across builds — and live lobby migration between
+fleet workers (:mod:`..fleet`), where a checkpoint crossing a host boundary
+is the whole hand-off.
+
+Determinism stance (v2 format): every checkpoint records the registry
+*schema* — the ordered ``(leaf path, dtype, shape)`` rows plus a digest —
+so a load against a drifted registry names the exact mismatched leaves
+instead of reporting a bare count, and a dtype mismatch **fails loudly by
+default**.  The old behavior (``jnp.asarray(arr, t.dtype)``) silently cast,
+which changes bits: a float64-saved/float32-loaded world resumes on a
+different trajectory and desyncs a migrated lobby against its control run.
+Pass ``allow_cast=True`` only for offline tooling that knowingly converts.
+"""
 
 from __future__ import annotations
 
-from typing import Tuple
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from .world import Registry, WorldState
 
-_FORMAT_VERSION = 1
+# v1: leaves + frame only.  v2 adds the schema rows/digest and the optional
+# ``extra_*`` payload namespace; v1 files still load (minus the schema
+# niceties — leaf-count mismatch is all v1 can diagnose).
+_FORMAT_VERSION = 2
+_V1 = 1
 
 
-def save_world(path: str, reg: Registry, world: WorldState, frame: int = 0) -> None:
-    """Serialize a WorldState (+frame) to a compressed .npz checkpoint."""
+@dataclasses.dataclass(frozen=True)
+class Checkpoint:
+    """A loaded checkpoint: the world, its frame, and any extra payloads
+    (e.g. a lobby's unsimulated input-queue tail — see fleet/lobby.py)."""
+
+    world: WorldState
+    frame: int
+    extras: Dict[str, np.ndarray]
+
+
+def _leaf_rows(template: WorldState) -> List[str]:
+    """Ordered ``path:dtype:shape`` schema rows for a registry's world
+    template — the names the mismatch diagnostics speak in."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(template)
+    rows = []
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        rows.append(
+            f"{jax.tree_util.keystr(path)}:{arr.dtype.name}:{tuple(arr.shape)}"
+        )
+    return rows
+
+
+def registry_schema(reg: Registry) -> List[str]:
+    """The registry's checkpoint schema: one ``path:dtype:shape`` row per
+    world leaf, in flatten order.  Stable across runs (flatten order is
+    registration order for the dict fields)."""
+    return _leaf_rows(reg.init_state())
+
+
+def schema_digest(reg: Registry) -> str:
+    """sha256 hex digest of :func:`registry_schema` — the cheap "same
+    registry?" handshake value recorded in every v2 checkpoint."""
+    return hashlib.sha256(
+        "\n".join(registry_schema(reg)).encode()
+    ).hexdigest()
+
+
+def save_world(
+    path,
+    reg: Registry,
+    world: WorldState,
+    frame: int = 0,
+    extras: Optional[Dict[str, np.ndarray]] = None,
+) -> None:
+    """Serialize a WorldState (+frame) to a compressed .npz checkpoint.
+
+    ``extras`` attaches named side arrays (stored under ``extra_<name>``):
+    the fleet migration path uses them for the input-queue tail so a
+    checkpoint is world + frame + pending inputs in ONE artifact.  ``path``
+    may be a filename or any file-like object (``np.savez_compressed``
+    contract), which is how checkpoints are built in memory for wire
+    transfer."""
     leaves, treedef = jax.tree.flatten(world)
+    schema = registry_schema(reg)
+    payload = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    for name, arr in (extras or {}).items():
+        if not name or not name.isidentifier():
+            raise ValueError(f"extra name {name!r} must be an identifier")
+        payload[f"extra_{name}"] = np.asarray(arr)
     np.savez_compressed(
         path,
         __version__=_FORMAT_VERSION,
         __frame__=frame,
         __n_leaves__=len(leaves),
-        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+        __schema__=np.array(json.dumps(schema)),
+        __schema_digest__=np.array(
+            hashlib.sha256("\n".join(schema).encode()).hexdigest()
+        ),
+        **payload,
     )
 
 
-def load_world(path: str, reg: Registry) -> Tuple[WorldState, int]:
-    """Returns (world, frame).  The registry must match the one that saved
-    (same registered components/resources — the treedef is reconstructed
-    from ``reg.init_state()``)."""
+def _schema_mismatch_error(saved: List[str], want: List[str]) -> ValueError:
+    """Name the drifted leaves, not just the count (the whole point of
+    recording the schema)."""
+    saved_set, want_set = set(saved), set(want)
+    only_ckpt = sorted(saved_set - want_set)
+    only_reg = sorted(want_set - saved_set)
+    parts = ["checkpoint schema does not match the registry"]
+    if only_ckpt:
+        parts.append(f"checkpoint-only leaves: {only_ckpt}")
+    if only_reg:
+        parts.append(f"registry-only leaves: {only_reg}")
+    if not only_ckpt and not only_reg:
+        parts.append("same leaves, different order — registration order changed")
+    parts.append("(registered types changed since the save?)")
+    return ValueError("; ".join(parts))
+
+
+def load_checkpoint(path, reg: Registry, allow_cast: bool = False) -> Checkpoint:
+    """Load a checkpoint saved by :func:`save_world`, schema-checked.
+
+    The registry must match the one that saved: v2 checkpoints carry the
+    full schema, so any drift (added/removed/renamed component, changed
+    dtype or shape) raises a ValueError naming the mismatched leaves.
+    A dtype mismatch is a determinism hazard — the silently-cast world
+    would change bits and desync a migrated lobby against an unmigrated
+    control — so it fails loudly unless ``allow_cast=True``."""
     z = np.load(path, allow_pickle=False)
-    if int(z["__version__"]) != _FORMAT_VERSION:
-        raise ValueError(f"unsupported checkpoint version {z['__version__']}")
+    version = int(z["__version__"])
+    if version not in (_V1, _FORMAT_VERSION):
+        raise ValueError(f"unsupported checkpoint version {version}")
     template = reg.init_state()
     t_leaves, treedef = jax.tree.flatten(template)
+    want_schema = registry_schema(reg)
     n = int(z["__n_leaves__"])
-    if n != len(t_leaves):
+    if version >= _FORMAT_VERSION:
+        saved_schema = json.loads(str(z["__schema__"]))
+        saved_digest = str(z["__schema_digest__"])
+        digest = hashlib.sha256("\n".join(want_schema).encode()).hexdigest()
+        if saved_digest != digest:
+            dtype_only = _dtype_only_drift(saved_schema, want_schema)
+            if not (dtype_only and allow_cast):
+                raise _schema_mismatch_error(saved_schema, want_schema)
+    elif n != len(t_leaves):
         raise ValueError(
             f"checkpoint has {n} leaves; registry expects {len(t_leaves)} "
             "(registered types changed?)"
@@ -48,9 +160,53 @@ def load_world(path: str, reg: Registry) -> Tuple[WorldState, int]:
     leaves = []
     for i, t in enumerate(t_leaves):
         arr = z[f"leaf_{i}"]
+        row = want_schema[i]
+        name = row.split(":", 1)[0]
         if arr.shape != tuple(t.shape):
             raise ValueError(
-                f"leaf {i} shape {arr.shape} != registry shape {tuple(t.shape)}"
+                f"leaf {name} (#{i}) shape {arr.shape} != registry shape "
+                f"{tuple(t.shape)}"
             )
-        leaves.append(jax.numpy.asarray(arr, t.dtype))
-    return jax.tree.unflatten(treedef, leaves), int(z["__frame__"])
+        t_dtype = np.asarray(t).dtype
+        if arr.dtype != t_dtype:
+            if not allow_cast:
+                raise ValueError(
+                    f"leaf {name} (#{i}) dtype {arr.dtype.name} != registry "
+                    f"dtype {t_dtype.name} — loading would silently change "
+                    "bits and desync a resumed/migrated run; pass "
+                    "allow_cast=True only if you mean to convert"
+                )
+            arr = arr.astype(t_dtype)
+        leaves.append(jax.numpy.asarray(arr))
+    extras = {
+        k[len("extra_"):]: z[k] for k in z.files if k.startswith("extra_")
+    }
+    return Checkpoint(
+        world=jax.tree.unflatten(treedef, leaves),
+        frame=int(z["__frame__"]),
+        extras=extras,
+    )
+
+
+def _dtype_only_drift(saved: List[str], want: List[str]) -> bool:
+    """True when the two schemas differ ONLY in leaf dtypes (same paths and
+    shapes, same order) — the one drift ``allow_cast=True`` may bridge."""
+    if len(saved) != len(want):
+        return False
+    for s, w in zip(saved, want):
+        sp = s.split(":")
+        wp = w.split(":")
+        if len(sp) != 3 or len(wp) != 3:
+            return False
+        if sp[0] != wp[0] or sp[2] != wp[2]:
+            return False
+    return True
+
+
+def load_world(
+    path, reg: Registry, allow_cast: bool = False
+) -> Tuple[WorldState, int]:
+    """Returns ``(world, frame)`` — thin wrapper over
+    :func:`load_checkpoint` keeping the historical two-tuple signature."""
+    ck = load_checkpoint(path, reg, allow_cast=allow_cast)
+    return ck.world, ck.frame

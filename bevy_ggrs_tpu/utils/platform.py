@@ -4,7 +4,15 @@ Some environments pre-import jax with a platform pinned via sitecustomize,
 making JAX_PLATFORMS ineffective; ``apply_platform_env()`` applies the
 ``BGT_PLATFORM`` env var (e.g. ``cpu``) through jax.config instead, plus an
 optional ``BGT_CPU_DEVICES`` virtual device count.  Called at the top of
-every example CLI so they are runnable anywhere (see docs/tpu_notes.md §4)."""
+every example CLI so they are runnable anywhere (see docs/tpu_notes.md §4).
+
+``JAX_PLATFORMS`` is honored as an alias for ``BGT_PLATFORM`` (lower
+precedence): an operator exporting the standard jax spelling must get the
+same protection, because in the sitecustomize environments above the env
+var alone is ineffective — NOTES.md round 5 records a 25-minute wedge where
+``JAX_PLATFORMS=cpu`` was set but a driver_bench subprocess applying only
+``BGT_*`` vars still tried to claim the dead TPU tunnel.  Fleet workers and
+bench stage subprocesses inherit whichever spelling the parent used."""
 
 from __future__ import annotations
 
@@ -12,8 +20,10 @@ import os
 
 
 def apply_platform_env() -> None:
-    """Apply BGT_PLATFORM / BGT_CPU_DEVICES through jax.config."""
-    platform = os.environ.get("BGT_PLATFORM")
+    """Apply BGT_PLATFORM (or its JAX_PLATFORMS alias) / BGT_CPU_DEVICES
+    through jax.config."""
+    platform = (os.environ.get("BGT_PLATFORM")
+                or os.environ.get("JAX_PLATFORMS"))
     ndev = os.environ.get("BGT_CPU_DEVICES")
     if not platform and not ndev:
         return

@@ -18,9 +18,17 @@ Rules that keep the gate honest on this heterogeneous history:
   bench.py but not gated;
 - LOWER-is-better upload-census metrics (``*uploads_per_tick*``,
   ``*dispatches_per_tick*``, ``*uploads_per_flush*`` from the ``uploads``
-  stage) gate in the opposite direction: the latest is compared against the
-  best (lowest) prior and an increase past the threshold fails — their
-  table delta is printed as "goodness" (negative = got worse);
+  stage, plus latency floors like ``rollback_service_p99_ms`` and
+  ``migration_downtime_ms``) gate in the opposite direction: the latest is
+  compared against the best (lowest) prior and an increase past the
+  threshold fails — their table delta is printed as "goodness" (negative =
+  got worse);
+- the gate is SPREAD-AWARE: a throughput delta inside either record's own
+  per-stage spread (bench.py ships ``(max-min)/median`` per stage, see
+  ``stage_spreads``) is annotated "within spread" and not flagged — that is
+  measured run-to-run wobble, not a regression — and ms-scale latency
+  floors tolerate an absolute increase of ``_MS_FLOOR_SLACK`` ms whatever
+  the ratio;
 - metrics the latest record does not carry are skipped, not failed
   (stage sets grew over rounds — r01 had no batched stage).
 
@@ -50,11 +58,18 @@ _EXCLUDE_RE = re.compile(r"(spread|bytes|pct|entities|depth|reps|lobbies)")
 # LOWER-is-better floor metrics: the packed/megastep/input-queue upload
 # censuses (bench.py stage_uploads) must hold at 1.0 per tick / per flush —
 # an INCREASE past the threshold is the regression (a staging path grew an
-# extra host->device upload or split a dispatch) — and the speculation
+# extra host->device upload or split a dispatch) — the speculation
 # stage's rollback-servicing p99s (bench.py _speculation_service_arm),
-# where an increase means rollback servicing got slower
+# where an increase means rollback servicing got slower, and the fleet
+# stage's live-migration downtime (bench.py stage_fleet)
 _FLOOR_RE = re.compile(r"(uploads_per_tick|dispatches_per_tick|"
-                       r"uploads_per_flush|rollback_service_p99_ms)")
+                       r"uploads_per_flush|rollback_service_p99_ms|"
+                       r"migration_downtime_ms)")
+
+# ms-scale floors carry scheduling jitter that dwarfs their absolute size
+# (a 7ms -> 25ms migration downtime is +257% relative but meaningless);
+# an increase within this many ms is never flagged, whatever the ratio
+_MS_FLOOR_SLACK = 50.0
 
 
 def load_records(dir: str) -> list:
@@ -78,6 +93,11 @@ def load_records(dir: str) -> list:
             continue
         parsed = rec.get("parsed")
         if isinstance(parsed, dict):
+            # record-level annotations (human notes on known noise, e.g. the
+            # r04->r05 batched wobble) ride along; string values never enter
+            # the numeric metric extractors
+            if isinstance(rec.get("annotations"), list):
+                parsed = dict(parsed, __annotations__=rec["annotations"])
             out.append((int(m.group(1)), parsed))
     return out
 
@@ -118,14 +138,42 @@ def floor_metrics(parsed: dict) -> dict:
     return out
 
 
+def _spread_for(flat: dict, metric: str) -> float:
+    """Best-effort run-to-run spread fraction for the stage a metric belongs
+    to, read from the record's own spread keys (bench.py ships every stage's
+    ``(max-min)/median`` spread, duplicated under ``stage_spreads``).  0.0
+    when the record carries no matching spread."""
+    stage = metric.split(".")[0] if "." in metric else metric.split("_")[0]
+    out = 0.0
+    for k, v in flat.items():
+        if "spread" not in k:
+            continue
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        # abbreviated spread names still match their stage ("spread_canon"
+        # covers canonical_mode_fps): compare stems prefix-wise
+        stem = re.sub(r"spread|stage|[._]", "", k)
+        stem_match = len(stem) >= 4 and (stage.startswith(stem)
+                                         or stem.startswith(stage))
+        if stage in k or stem_match or (metric == "value" and k == "spread"):
+            out = max(out, float(v))
+    return out
+
+
 def compare(records: list, threshold: float) -> tuple:
     """Latest-vs-best-prior comparison.
 
     Returns ``(rows, regressions)`` where each row is ``(metric, best_prior,
-    best_round, latest, delta_frac_or_None)``.  ``regressions`` lists the
-    rows whose delta is below ``-threshold``."""
+    best_round, latest, delta_frac_or_None, note)``.  ``regressions`` lists
+    the rows whose delta is below ``-threshold`` AND outside the measured
+    noise: a throughput delta inside either record's own per-stage spread is
+    annotated ``within spread`` instead of flagged (single-shot numbers on a
+    shared host wobble; the spread is the measured wobble), and an ms-scale
+    floor increase inside ``_MS_FLOOR_SLACK`` is annotated ``within ms
+    slack`` (relative deltas on ~10ms latencies are jitter, not signal)."""
     latest_round, latest = records[-1]
     platform = latest.get("platform")
+    latest_flat = _flatten(latest)
     priors = [
         (n, p) for n, p in records[:-1]
         if platform is None or p.get("platform") == platform
@@ -135,25 +183,35 @@ def compare(records: list, threshold: float) -> tuple:
                                      (floor_metrics, True)):
         latest_m = extract(latest)
         for metric in sorted(latest_m):
-            best = best_round = None
+            best = best_round = best_parsed = None
             for n, p in priors:
                 v = extract(p).get(metric)
                 if v is None or v <= 0:
                     continue
                 if best is None or (v < best if lower_is_better
                                     else v > best):
-                    best, best_round = v, n
+                    best, best_round, best_parsed = v, n, p
             if best is None:
-                rows.append((metric, None, None, latest_m[metric], None))
+                rows.append((metric, None, None, latest_m[metric], None, ""))
                 continue
             # delta is always "goodness": negative = got worse, so the
             # single `< -threshold` regression test covers both directions
             delta = (latest_m[metric] - best) / best
             if lower_is_better:
                 delta = -delta
-            row = (metric, best, best_round, latest_m[metric], delta)
-            rows.append(row)
+            note = ""
             if delta < -threshold:
+                if lower_is_better and metric.endswith("_ms") and (
+                        latest_m[metric] - best <= _MS_FLOOR_SLACK):
+                    note = "within ms slack"
+                elif not lower_is_better:
+                    noise = max(_spread_for(latest_flat, metric),
+                                _spread_for(_flatten(best_parsed), metric))
+                    if -delta <= noise:
+                        note = "within spread"
+            row = (metric, best, best_round, latest_m[metric], delta, note)
+            rows.append(row)
+            if delta < -threshold and not note:
                 regressions.append(row)
     return (latest_round, platform, rows, regressions)
 
@@ -165,11 +223,14 @@ def print_table(latest_round: int, platform, rows: list,
           f"vs best prior same-platform record, threshold {threshold:.0%}")
     w = max((len(r[0]) for r in rows), default=6)
     print(f"  {'metric':<{w}}  {'best prior':>12}  {'latest':>12}  delta")
-    for metric, best, best_round, latest, delta in rows:
+    for metric, best, best_round, latest, delta, note in rows:
         if delta is None:
             print(f"  {metric:<{w}}  {'-':>12}  {latest:>12.1f}  (new)")
             continue
-        flag = "  << REGRESSION" if delta < -threshold else ""
+        if note:
+            flag = f"  ({note})"
+        else:
+            flag = "  << REGRESSION" if delta < -threshold else ""
         print(f"  {metric:<{w}}  {best:>9.1f}(r{best_round:02d})"
               f"  {latest:>12.1f}  {delta:+7.1%}{flag}")
 
@@ -197,6 +258,8 @@ def main(argv=None) -> int:
         records, args.threshold
     )
     print_table(latest_round, platform, rows, args.threshold)
+    for note in records[-1][1].get("__annotations__", []):
+        print(f"  note: {note}")
     if not any(r[4] is not None for r in rows):
         print("bench_history: no same-platform prior record — no gate")
         return 0

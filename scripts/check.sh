@@ -32,14 +32,18 @@ g.dryrun_multichip(8)
 print("graft ok")
 EOF
 
-echo "== bench smoke (batched + sharded + netstats + uploads + speculation + trace stages, gates armed) =="
+echo "== bench smoke (batched + sharded + netstats + uploads + speculation + trace + fleet stages, gates armed) =="
 # the sharded stage runs under forced 8-virtual-device CPU and hard-fails
 # unless per-device dispatches per tick are flat across lobby counts; the
 # netstats stage hard-fails unless every rollback carries a blamed handle
 # (sum(rollback_cause_total) == rollbacks_total), the sampler costs <1% of
 # the tick, and /qos serves a usable lobby_qos_score; the speculation stage
 # hard-fails unless cache-hit rollback servicing p99 is >=5x below the
-# miss/resim path at a >50% hit rate with the steady census unchanged
+# miss/resim path at a >50% hit rate with the steady census unchanged; the
+# fleet stage runs a real 2-worker fleet and hard-fails on any desync after
+# live migration or SIGKILL failover, a failover that did not resume from
+# the last confirmed checkpoint, or an admission reject that is not
+# wire-visible
 python bench.py --smoke
 
 echo "== bench =="

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Fleet scheduler daemon: matchmaking, placement, migration, failover.
+
+    python scripts/fleet_scheduler.py --port 3600 --metrics-port 9464
+
+Workers (scripts/fleet_worker.py) register against the port; clients submit
+lobbies with SUBMIT datagrams (bevy_ggrs_tpu.fleet.FleetClient).  The 5 s
+reporting loop prints the placement snapshot and refreshes the ``fleet_*``
+gauges; with ``--metrics-port`` the registry is scrapable as Prometheus
+text (docs/observability.md "Fleet scheduling")."""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu import telemetry
+from bevy_ggrs_tpu.fleet import FleetScheduler
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=3600)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--worker-timeout", type=float, default=2.0,
+                    help="heartbeat silence before a worker is declared "
+                         "dead and its lobbies failed over (s)")
+    ap.add_argument("--mem-budget-mb", type=int, default=512,
+                    help="per-worker device-bytes admission budget")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics on this port")
+    ap.add_argument("--metrics-host", default="127.0.0.1")
+    args = ap.parse_args()
+    telemetry.enable()
+    exporter = None
+    if args.metrics_port is not None:
+        exporter = telemetry.start_http_exporter(
+            port=args.metrics_port, host=args.metrics_host
+        )
+        print(f"metrics on http://{args.metrics_host}:{exporter.port}"
+              f"/metrics", flush=True)
+    sched = FleetScheduler(
+        host=args.host, port=args.port,
+        worker_timeout_s=args.worker_timeout,
+        mem_budget_bytes=args.mem_budget_mb * 1024 * 1024,
+    )
+    print(f"fleet scheduler on {sched.local_addr}", flush=True)
+    last_report = 0.0
+    try:
+        while True:
+            sched.poll()
+            now = time.monotonic()
+            if now - last_report >= 5.0:
+                last_report = now
+                snap = sched.snapshot()
+                if snap["workers"] or snap["lobbies"]:
+                    print(json.dumps(
+                        {k: snap[k] for k in ("workers", "lobbies")}
+                    ), flush=True)
+            time.sleep(0.002)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sched.close()
+        if exporter is not None:
+            exporter.close()
+
+
+if __name__ == "__main__":
+    main()

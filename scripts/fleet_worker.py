@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Fleet worker host process: registers with a scheduler and runs lobbies.
+
+    python scripts/fleet_worker.py --scheduler 127.0.0.1:3600 \
+        --worker-id w0 --capacity 4
+
+One process = one worker = one accelerator's worth of lobby hosting.  The
+worker polls forever: it accepts PLACE/DRAIN/RESUME/DROP commands, advances
+hosted lobbies by a bounded frame budget per poll, ships confirmed
+checkpoints back to the scheduler (the failover source), and heartbeats its
+load/QoS stats.  ``BGT_PLATFORM``/``JAX_PLATFORMS`` select the backend
+(bevy_ggrs_tpu/utils/platform.py).  The bench fleet stage spawns two of
+these and SIGKILLs one mid-game (bench.py stage_fleet)."""
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+from bevy_ggrs_tpu.utils.platform import apply_platform_env
+
+apply_platform_env()
+
+from bevy_ggrs_tpu import telemetry
+from bevy_ggrs_tpu.fleet import FleetWorker
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scheduler", default="127.0.0.1:3600",
+                    help="scheduler host:port")
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--capacity", type=int, default=4,
+                    help="max concurrently hosted lobbies")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="exit after this many seconds (default: run forever)")
+    ap.add_argument("--ckpt-every", type=int, default=120,
+                    help="confirmed-checkpoint shipping cadence (frames)")
+    ap.add_argument("--step-budget", type=int, default=16,
+                    help="max frames per lobby per poll")
+    ap.add_argument("--pace-fps", type=float, default=0.0,
+                    help="cap running lobbies to this realtime frame rate "
+                         "(0 = unpaced)")
+    args = ap.parse_args()
+    telemetry.enable()
+    host, _, port = args.scheduler.rpartition(":")
+    worker = FleetWorker(
+        args.worker_id, (host or "127.0.0.1", int(port)),
+        capacity=args.capacity, ckpt_every_frames=args.ckpt_every,
+        step_budget=args.step_budget, pace_fps=args.pace_fps,
+    )
+    print(f"fleet worker {args.worker_id} on {worker.local_addr} -> "
+          f"scheduler {args.scheduler}", flush=True)
+    try:
+        worker.run(duration_s=args.duration)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        worker.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,283 @@
+"""Fleet scheduler subsystem: wire protocol round-trips, checkpoint
+chunking, lobby bit-determinism across checkpoint/restore, and the full
+scheduler/worker control loop over loopback UDP — placement, wire-visible
+admission rejects, live migration bit-equality, and failover from the last
+confirmed checkpoint."""
+
+import time
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import telemetry
+from bevy_ggrs_tpu.fleet import (
+    ChunkAssembler,
+    FleetClient,
+    FleetScheduler,
+    FleetWorker,
+    LobbySim,
+    LobbySpec,
+    checksum_hex,
+    chunk_checkpoint,
+    decode,
+)
+from bevy_ggrs_tpu.fleet import protocol as P
+
+SMALL = dict(app="stress_soa", entities=32, seed=9)
+
+
+# -- protocol ---------------------------------------------------------------
+
+
+def test_protocol_roundtrips():
+    cases = [
+        (P.encode_register("w0", 7), P.T_REGISTER,
+         lambda m: (m.a, m.total) == ("w0", 7)),
+        (P.encode_heartbeat("w0", {"capacity": 2}), P.T_HEARTBEAT,
+         lambda m: m.obj == {"capacity": 2}),
+        (P.encode_place("l0", {"app": "stress_soa"}), P.T_PLACE,
+         lambda m: m.obj["app"] == "stress_soa"),
+        (P.encode_place_ok("l0", 42), P.T_PLACE_OK, lambda m: m.frame == 42),
+        (P.encode_drain("l0", 99), P.T_DRAIN, lambda m: m.frame == 99),
+        (P.encode_ckpt_ack("l0", 10), P.T_CKPT_ACK, lambda m: m.frame == 10),
+        (P.encode_resume("l0", 10, {"seed": 1}), P.T_RESUME,
+         lambda m: (m.frame, m.obj) == (10, {"seed": 1})),
+        (P.encode_resume_ok("l0", 10), P.T_RESUME_OK, lambda m: m.frame == 10),
+        (P.encode_drop("l0"), P.T_DROP, lambda m: m.a == "l0"),
+        (P.encode_submit("l0", {"entities": 3}), P.T_SUBMIT,
+         lambda m: m.obj == {"entities": 3}),
+        (P.encode_submit_ok("l0", "w1"), P.T_SUBMIT_OK, lambda m: m.b == "w1"),
+        (P.encode_reject("l0", "capacity"), P.T_REJECT,
+         lambda m: m.b == "capacity"),
+        (P.encode_done("l0", 600, "ab" * 8), P.T_DONE,
+         lambda m: (m.frame, m.b) == (600, "ab" * 8)),
+    ]
+    for data, kind, check in cases:
+        msg = decode(data)
+        assert msg is not None and msg.kind == kind and msg.a[0] in "wl"
+        assert check(msg), kind
+
+
+def test_protocol_drops_malformed():
+    assert decode(b"") is None
+    assert decode(b"\x00\x01\x02") is None  # wrong magic
+    # truncated register: header + type but no payload
+    from bevy_ggrs_tpu.session.room import ROOM_MAGIC, _HDR
+
+    assert decode(_HDR.pack(ROOM_MAGIC, P.T_REGISTER)) is None
+    assert decode(_HDR.pack(ROOM_MAGIC, 250)) is None  # unknown type
+
+
+def test_chunk_assembler_out_of_order_and_supersede():
+    blob = bytes(range(256)) * 600  # > 4 chunks
+    grams = chunk_checkpoint("l0", 5, blob)
+    assert len(grams) > 2
+    asm = ChunkAssembler()
+    msgs = [decode(g) for g in grams]
+    # out of order: all but the first, then the first
+    for m in msgs[1:]:
+        assert asm.offer(m) is None
+    assert asm.offer(msgs[0]) == blob
+    # a newer frame's chunks supersede a stale partial for the same lobby
+    asm2 = ChunkAssembler()
+    asm2.offer(msgs[0])
+    newer = [decode(g) for g in chunk_checkpoint("l0", 6, blob)]
+    for m in newer[:-1]:
+        assert asm2.offer(m) is None
+    assert asm2.offer(newer[-1]) == blob
+    assert asm2.pending() == []
+
+
+# -- lobby determinism ------------------------------------------------------
+
+
+def test_lobby_checkpoint_restore_bit_equality():
+    # the migration invariant: straight run == run split by a checkpoint/
+    # restore at an awkward (non-chunk-aligned) frame, bit for bit
+    spec = LobbySpec(lobby_id="l0", target_frames=90, **SMALL)
+    control = LobbySim(spec)
+    control.run_to(90)
+    a = LobbySim(spec)
+    a.run_to(37)
+    b = LobbySim.restore(spec, a.checkpoint_bytes())
+    assert b.frame == 37
+    b.run_to(90)
+    assert b.checksum() == control.checksum()
+
+
+def test_lobby_external_input_tail_rides_checkpoint():
+    # external-mode lobbies advance only through queued inputs; the
+    # unsimulated tail must survive the checkpoint or the resumed lobby
+    # would stall (or worse, desync on regenerated inputs)
+    spec = LobbySpec(lobby_id="e0", app="box_game", target_frames=20,
+                     input_mode="external")
+    sim = LobbySim(spec)
+    for f in range(1, 11):
+        sim.submit_input(f, np.full(
+            (sim.app.num_players, *sim.app.input_shape), f,
+            sim.app.input_dtype,
+        ))
+    sim.step(6)
+    assert sim.frame == 6
+    restored = LobbySim.restore(spec, sim.checkpoint_bytes())
+    assert restored.frame == 6
+    assert sorted(restored.pending) == [7, 8, 9, 10]
+    restored.step(20)
+    assert restored.frame == 10  # only the shipped tail was simulatable
+    # and the tail produced the same state as never migrating at all
+    sim.step(20)
+    assert sim.frame == 10
+    assert restored.checksum() == sim.checksum()
+    with pytest.raises(ValueError):
+        restored.submit_input(3, np.zeros(
+            (restored.app.num_players, *restored.app.input_shape),
+            restored.app.input_dtype,
+        ))
+
+
+# -- scheduler/worker over loopback UDP ------------------------------------
+
+
+def _pump(sched, workers, n=1, sleep=0.002):
+    for _ in range(n):
+        sched.poll()
+        for w in workers:
+            w.poll()
+        time.sleep(sleep)
+
+
+def _pump_until(sched, workers, cond, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        _pump(sched, workers)
+        if cond():
+            return True
+    return False
+
+
+@pytest.fixture()
+def fleet():
+    telemetry.reset()
+    telemetry.enable()
+    sched = FleetScheduler(worker_timeout_s=30.0)  # no spurious deaths
+    workers = [
+        FleetWorker(f"w{i}", sched.local_addr, capacity=2,
+                    ckpt_every_frames=25)
+        for i in range(2)
+    ]
+    for w in workers:
+        w.register()
+    assert _pump_until(sched, workers, lambda: len(sched.workers) == 2, 10)
+    yield sched, workers
+    for w in workers:
+        w.close()
+    sched.close()
+    telemetry.disable()
+
+
+def test_fleet_live_migration_bit_equality(fleet):
+    sched, workers = fleet
+    spec = LobbySpec(lobby_id="mig", target_frames=300, **SMALL)
+    ok, wid = sched.submit(spec)
+    assert ok
+    rec = sched.lobbies["mig"]
+    assert _pump_until(sched, workers, lambda: rec.state == "running", 10)
+    assert sched.migrate("mig")
+    assert _pump_until(
+        sched, workers,
+        lambda: rec.state == "running" and rec.worker_id != wid, 30,
+    ), "migration did not complete"
+    assert _pump_until(sched, workers, lambda: rec.state == "done", 30)
+    control = LobbySim(spec)
+    control.run_to(300)
+    assert rec.final_checksum == checksum_hex(control.checksum())
+    series = telemetry.summary()["metrics"]["lobby_migrations_total"]["series"]
+    assert series.get("outcome=ok") == 1
+    hist = telemetry.summary()["metrics"].get("migration_downtime_ms")
+    assert hist is not None  # downtime was observed
+
+
+def test_fleet_admission_reject_is_wire_visible(fleet):
+    sched, workers = fleet
+    for i in range(4):  # 2 workers x capacity 2
+        ok, _ = sched.submit(
+            LobbySpec(lobby_id=f"fill{i}", target_frames=10_000, **SMALL)
+        )
+        assert ok
+    # in-process verdict
+    ok, reason = sched.submit(LobbySpec(lobby_id="over", **SMALL))
+    assert not ok and reason == "capacity"
+    # wire verdict: a FleetClient must receive the REJECT datagram
+    import threading
+
+    cli = FleetClient(sched.local_addr)
+    stop = threading.Event()
+
+    def pumper():
+        while not stop.is_set():
+            _pump(sched, workers)
+
+    t = threading.Thread(target=pumper)
+    t.start()
+    try:
+        got = cli.submit(LobbySpec(lobby_id="over2", **SMALL), timeout_s=10)
+    finally:
+        stop.set()
+        t.join()
+        cli.close()
+    assert got is None and cli.last_reject == "capacity"
+    series = telemetry.summary()["metrics"]["admission_rejects_total"]["series"]
+    assert series.get("reason=capacity", 0) >= 2
+
+
+def test_fleet_failover_from_confirmed_checkpoint(fleet):
+    sched, workers = fleet
+    # long enough that the survivor's restore-compile stall cannot get IT
+    # declared dead, short enough that the test stays snappy
+    sched.worker_timeout_s = 2.0
+    spec = LobbySpec(lobby_id="vic", target_frames=1200, **SMALL)
+    ok, _ = sched.submit(spec)
+    assert ok
+    rec = sched.lobbies["vic"]
+    # run until a confirmed checkpoint is in hand but the game is not over
+    assert _pump_until(
+        sched, workers,
+        lambda: rec.ckpt_blob is not None and rec.state == "running", 20,
+    )
+    assert rec.frame < 1200
+    victim = next(w for w in workers if w.worker_id == rec.worker_id)
+    survivor = next(w for w in workers if w is not victim)
+    victim.close()
+    assert _pump_until(sched, [survivor], lambda: rec.state == "done", 60), \
+        f"no failover completion (state={rec.state})"
+    control = LobbySim(spec)
+    control.run_to(1200)
+    assert rec.final_checksum == checksum_hex(control.checksum())
+    series = telemetry.summary()["metrics"]["lobby_migrations_total"]["series"]
+    assert series.get("outcome=failover") == 1
+
+
+def test_scheduler_placement_is_bytes_and_slot_aware():
+    # greedy placement prefers the emptier worker; memory budget rejects
+    # with the wire-visible "memory" reason before slots run out
+    telemetry.reset()
+    sched = FleetScheduler(worker_timeout_s=30.0,
+                           mem_budget_bytes=40 * 1024)
+    w = FleetWorker("w0", sched.local_addr, capacity=8)
+    w.register()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not sched.workers:
+            sched.poll()
+            w.poll()
+            time.sleep(0.002)
+        assert "w0" in sched.workers
+        # stress_soa(32 entities): 6 float32 cols + bookkeeping ~ a few KB
+        ok, _ = sched.submit(LobbySpec(lobby_id="a", **SMALL))
+        assert ok
+        big = LobbySpec(lobby_id="b", app="stress_soa", entities=4096, seed=1)
+        ok, reason = sched.submit(big)
+        assert not ok and reason == "memory"
+    finally:
+        w.close()
+        sched.close()

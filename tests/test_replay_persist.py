@@ -181,3 +181,89 @@ def test_checkpoint_rejects_registry_mismatch(tmp_path):
     other.rollback_component("extra", (), np.int32)
     with pytest.raises(ValueError):
         load_world(path, other.reg)
+
+
+def test_checkpoint_records_schema_digest_and_extras(tmp_path):
+    # v2 checkpoints carry the registry schema + digest and named extras;
+    # the round-trip preserves frame, digest, and extra payloads exactly
+    from bevy_ggrs_tpu.snapshot.persist import (
+        load_checkpoint, registry_schema, schema_digest,
+    )
+
+    app, _, runner = record_run(ticks=5)
+    path = str(tmp_path / "ckpt.npz")
+    tail = np.arange(6, dtype=np.int64)
+    save_world(path, app.reg, runner.world, frame=runner.frame,
+               extras={"tail_frames": tail})
+    z = np.load(path, allow_pickle=False)
+    assert str(z["__schema_digest__"]) == schema_digest(app.reg)
+    rows = registry_schema(app.reg)
+    assert rows and all(r.count(":") >= 2 for r in rows)
+    ck = load_checkpoint(path, app.reg)
+    assert ck.frame == runner.frame
+    np.testing.assert_array_equal(ck.extras["tail_frames"], tail)
+    assert checksum_to_int(app.checksum_fn(ck.world)) == checksum_to_int(
+        app.checksum_fn(runner.world)
+    )
+
+
+def test_checkpoint_schema_error_names_drifted_leaves(tmp_path):
+    # the mismatch diagnostic must name the drifted leaves, not just count
+    import pytest
+
+    app, _, runner = record_run(ticks=3)
+    path = str(tmp_path / "ckpt.npz")
+    save_world(path, app.reg, runner.world)
+    other = box_game.make_app(num_players=2)
+    other.rollback_component("shield_timer", (), np.int32)
+    with pytest.raises(ValueError, match="shield_timer"):
+        load_world(path, other.reg)
+
+
+def test_checkpoint_dtype_mismatch_loud_unless_allow_cast(tmp_path):
+    # dtype drift changes bits: rejected by default, bridged by allow_cast
+    import jax.numpy as jnp
+    import pytest
+
+    from bevy_ggrs_tpu.app import App
+
+    def build(dtype):
+        a = App(num_players=1, capacity=4, input_shape=(),
+                input_dtype=np.uint8)
+        a.rollback_component("val", (), dtype, checksum=True)
+        a.set_step(lambda w, ctx: w)
+        return a
+
+    a32 = build(jnp.int32)
+    w = a32.init_state()
+    path = str(tmp_path / "d.npz")
+    save_world(path, a32.reg, w, frame=7)
+
+    a16 = build(jnp.int16)
+    with pytest.raises(ValueError, match="val"):
+        load_world(path, a16.reg)
+    world, frame = load_world(path, a16.reg, allow_cast=True)
+    assert frame == 7
+    assert np.asarray(world.comps["val"]).dtype == np.int16
+
+
+def test_v1_checkpoint_dtype_mismatch_is_loud_per_leaf(tmp_path):
+    # v1 files have no schema to compare, so the per-leaf dtype check is
+    # the only guard — it must fail loudly too (the seed silently cast)
+    import jax
+    import pytest
+
+    app, _, runner = record_run(ticks=3)
+    leaves, _ = jax.tree.flatten(runner.world)
+    path = str(tmp_path / "v1.npz")
+    payload = {
+        f"leaf_{i}": np.asarray(x).astype(np.float64)
+        if np.asarray(x).dtype == np.float32 else np.asarray(x)
+        for i, x in enumerate(leaves)
+    }
+    np.savez_compressed(path, __version__=1, __frame__=3,
+                        __n_leaves__=len(leaves), **payload)
+    with pytest.raises(ValueError, match="dtype"):
+        load_world(path, app.reg)
+    world, frame = load_world(path, app.reg, allow_cast=True)
+    assert frame == 3
