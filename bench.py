@@ -1035,6 +1035,9 @@ UPLOADS_TICKS = 150
 UPLOADS_WARM = 40
 MEGASTEP_N = 8
 MEGASTEP_FLUSHES = 16
+SANITIZER_CALLS = 20_000
+SANITIZER_MAX_OVERHEAD_PCT = 2.0
+SANITIZER_MAX_OFF_US = 1.5
 
 
 def stage_uploads():
@@ -1055,7 +1058,11 @@ def stage_uploads():
     device-resident staging queue (utils/staging.StagingQueue) that moves
     the transfer-safety block off the tick's critical path; its census must
     stay EXACTLY 1 upload + 1 dispatch per frame, the rotation only changes
-    WHEN the block happens.
+    WHEN the block happens.  Arm 4 prices the ``BGT_SANITIZE`` transfer
+    sanitizer (utils/staging.TransferSanitizer): a packed tick's whole
+    ledger transaction is 4 hook calls (pack_prefix guard_write + commit's
+    guard_write/begin/land), microbenchmarked armed and disarmed against
+    arm 1's measured tick wall.
 
     HARD GATES (raise -> nonzero exit):
 
@@ -1063,7 +1070,10 @@ def stage_uploads():
        advanced over the measured window (1 upload + 1 dispatch per tick);
     2. megastep — every flush owing exactly N frames cost exactly 1
        dispatch + 1 upload, and at least half the flushes were exact;
-    3. input queue — same 1+1 census as arm 1 over the rotating buffers.
+    3. input queue — same 1+1 census as arm 1 over the rotating buffers;
+    4. sanitizer — armed, the per-tick transaction is < 2% of the packed
+       tick wall; disarmed (the default), < 1.5us per tick (the hooks
+       collapse to one attribute check each).
 
     ``BGT_BENCH_SMOKE=1`` shrinks the windows; all gates stay armed."""
     jax = _stage_setup()
@@ -1082,7 +1092,7 @@ def stage_uploads():
                            "staging path")
     d0, u0, f0 = (r0.device_dispatches, r0.stats()["host_uploads"], r0.frame)
     b0 = r0.stats()["packed_upload_bytes"]
-    _slice_ticks(jax, net, runners, ticks, dt)
+    packed_wall = _slice_ticks(jax, net, runners, ticks, dt)
     st = r0.stats()
     packed_d = r0.device_dispatches - d0
     packed_u = st["host_uploads"] - u0
@@ -1153,6 +1163,41 @@ def stage_uploads():
             "(required: 1 + 1 per frame; the rotation must not add or "
             "drop uploads)"
         )
+
+    # -- arm 4: transfer-sanitizer overhead -------------------------------
+    from bevy_ggrs_tpu.utils.staging import TransferSanitizer
+
+    calls = 2_000 if smoke else SANITIZER_CALLS
+    buf = np.zeros((MEGASTEP_N + 1, 64), np.int8)
+    tick_us = packed_wall / (2 * ticks) * 1e6  # both runners share a tick
+
+    def _transaction_us(san):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            # one packed tick's ledger traffic: pack_prefix's guard, then
+            # commit's guard/begin/land
+            san.guard_write(buf)
+            san.guard_write(buf)
+            san.begin(buf)
+            san.land(buf)
+        return (time.perf_counter() - t0) / calls * 1e6
+
+    san_off_us = _transaction_us(TransferSanitizer(enabled=False))
+    san_on_us = _transaction_us(TransferSanitizer(enabled=True))
+    san_pct = 100.0 * san_on_us / tick_us if tick_us else 0.0
+    if san_pct >= SANITIZER_MAX_OVERHEAD_PCT:
+        raise RuntimeError(
+            f"uploads gate: BGT_SANITIZE=1 costs {san_on_us:.2f}us per "
+            f"packed tick = {san_pct:.3f}% of the {tick_us:.1f}us tick "
+            f"(required: < {SANITIZER_MAX_OVERHEAD_PCT}%)"
+        )
+    if san_off_us >= SANITIZER_MAX_OFF_US:
+        raise RuntimeError(
+            f"uploads gate: DISABLED sanitizer costs {san_off_us:.2f}us "
+            "per packed tick — the default path must stay one attribute "
+            f"check per hook (< {SANITIZER_MAX_OFF_US}us)"
+        )
+
     return {
         "uploads_per_tick_packed": round(packed_u / packed_f, 3),
         "dispatches_per_tick_packed": round(packed_d / packed_f, 3),
@@ -1166,6 +1211,9 @@ def stage_uploads():
         "uploads_per_tick_input_queue": round(queue_u / queue_f, 3),
         "input_queue_landed_free": stq["staging_landed_free"],
         "input_queue_deferred_blocks": stq["staging_deferred_blocks"],
+        "sanitizer_on_us_per_tick": round(san_on_us, 3),
+        "sanitizer_off_us_per_tick": round(san_off_us, 3),
+        "sanitizer_overhead_pct": round(san_pct, 3),
         "uploads_rep_policy": (
             f"steady p2p census over {ticks} ticks after {UPLOADS_WARM} "
             f"warm; megastep census over {flushes} x {MEGASTEP_N}-frame "

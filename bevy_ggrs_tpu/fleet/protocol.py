@@ -42,10 +42,45 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import struct
 from typing import Any, List, Optional, Tuple
 
 from ..session.room import ROOM_MAGIC, _HDR, _Reader, _pack_str
+
+log = logging.getLogger(__name__)
+
+# peers we have already warned about — the counter keeps counting, the
+# log line fires once per peer so a hostile/misconfigured sender cannot
+# flood the scheduler's log at datagram rate
+_malformed_peers: set = set()
+
+
+def note_malformed(addr=None) -> None:
+    """Account one datagram :func:`decode` dropped (wrong magic, truncated
+    fields, bad JSON, unknown type byte — ANY failed decode counts) into
+    ``fleet_malformed_datagrams_total`` and warn once per peer.
+
+    Callers pass the ``recvfrom`` address when they have it; a ``None``
+    peer is grouped under ``<unknown>``."""
+    from .. import telemetry
+
+    telemetry.count(
+        "fleet_malformed_datagrams_total",
+        help="fleet datagrams dropped by the decoder as malformed or "
+             "non-fleet (any failed decode, unknown type bytes included)",
+    )
+    peer = (
+        f"{addr[0]}:{addr[1]}"
+        if isinstance(addr, tuple) and len(addr) >= 2
+        else "<unknown>"
+    )
+    if peer not in _malformed_peers:
+        _malformed_peers.add(peer)
+        log.warning(
+            "fleet: dropping malformed datagram(s) from %s (counted in "
+            "fleet_malformed_datagrams_total)", peer,
+        )
 
 # fleet message range: 32+ (room control types are 1..8)
 T_REGISTER = 32

@@ -519,6 +519,8 @@ class FleetScheduler:
             msg = P.decode(data)
             if msg is not None:
                 self._handle(msg, addr, time.monotonic())
+            else:
+                P.note_malformed(addr)
         now = time.monotonic()
         for wid, w in list(self.workers.items()):
             if now - w.last_seen > self.worker_timeout_s:
@@ -601,12 +603,15 @@ class FleetClient:
                 except OSError:
                     pass
             try:
-                data, _addr = self._sock.recvfrom(65536)
+                data, addr = self._sock.recvfrom(65536)
             except (BlockingIOError, OSError):
                 time.sleep(0.01)
                 continue
             msg = P.decode(data)
-            if msg is None or msg.a != spec.lobby_id:
+            if msg is None:
+                P.note_malformed(addr)
+                continue
+            if msg.a != spec.lobby_id:
                 continue
             if msg.kind == P.T_SUBMIT_OK:
                 return msg.b

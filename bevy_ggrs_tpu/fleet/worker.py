@@ -313,12 +313,14 @@ class FleetWorker:
         step budget, ship/re-ship checkpoints, heartbeat, report DONEs."""
         while True:
             try:
-                data, _addr = self._sock.recvfrom(65536)
+                data, addr = self._sock.recvfrom(65536)
             except (BlockingIOError, OSError):
                 break
             msg = P.decode(data)
             if msg is not None:
                 self._handle(msg)
+            else:
+                P.note_malformed(addr)
         for lid, h in list(self.lobbies.items()):
             self._advance(lid, h)
             now = time.monotonic()

@@ -461,6 +461,7 @@ class BucketedWaveExecutor:
         # persistent staging buffers are rewritten next wave: commit the
         # sliced uploads synchronously so the asynchronous transfer can
         # never read a later wave's bytes (utils/staging.py)
+        from ..utils import staging
         from ..utils.staging import commit
 
         inp = commit(inputs[:, :bucket])
@@ -480,9 +481,16 @@ class BucketedWaveExecutor:
                         "exact", bucket, worlds, inp, st, starts
                     )
                 else:
+                    san = staging.sanitizer()
+                    san.guard_donated(prev[0], "batch.run_wave/stacked")
+                    san.guard_donated(prev[1], "batch.run_wave/checks")
                     finals, stacked, checks = self._dispatch(
                         *key, worlds, inp, st, starts, *prev
                     )
+                    # the dispatch donated prev's device buffers: any
+                    # later reuse of those handles is a race
+                    san.donate(prev[0], "exact_recycle stacked")
+                    san.donate(prev[1], "exact_recycle checks")
                 self._prev_out[key] = (stacked, checks)
             else:
                 finals, stacked, checks = self._dispatch(
@@ -509,6 +517,7 @@ class BucketedWaveExecutor:
             raise ValueError("run_wave needs at least one advancing lobby")
         bucket = self.bucket_for(k_hot)
         exact = all(k == bucket for k in ks)
+        from ..utils import staging
         from ..utils.staging import commit
 
         pk = commit(packed[:, :bucket + 1])
@@ -525,9 +534,14 @@ class BucketedWaveExecutor:
                         "packed_exact", bucket, worlds, pk
                     )
                 else:
+                    san = staging.sanitizer()
+                    san.guard_donated(prev[0], "batch.run_wave_packed/stacked")
+                    san.guard_donated(prev[1], "batch.run_wave_packed/checks")
                     finals, stacked, checks = self._dispatch(
                         *key, worlds, pk, *prev
                     )
+                    san.donate(prev[0], "packed_exact_recycle stacked")
+                    san.donate(prev[1], "packed_exact_recycle checks")
                 self._prev_out[key] = (stacked, checks)
             else:
                 finals, stacked, checks = self._dispatch(

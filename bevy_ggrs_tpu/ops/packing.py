@@ -98,7 +98,14 @@ class PackedSpec:
 def pack_prefix(buf: np.ndarray, start_frame: int, n_real: int,
                 has_load: int = 0, load_slot: int = 0) -> None:
     """Write the int32 prefix words into row 0 of ``buf`` (``int8[k+1, W]``
-    or a single lane of a batch buffer)."""
+    or a single lane of a batch buffer).
+
+    This is the first rewrite of every packed tick, so it is the one
+    sanitizer checkpoint for the whole pack (prefix, rows, pad all rewrite
+    the same backing buffer a ``guard_write`` here has already cleared)."""
+    from ..utils import staging
+
+    staging.sanitizer().guard_write(buf, "packing.pack_prefix")
     pf = buf[0, :PREFIX_BYTES].view(np.int32)
     pf[0] = start_frame
     pf[1] = n_real

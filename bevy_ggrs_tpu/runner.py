@@ -1061,13 +1061,17 @@ class GgrsRunner:
                 self._devmem_tag + "/staging",
                 self._stage_inputs.nbytes + self._stage_status.nbytes,
             )
+        from .utils import staging
+
+        san = staging.sanitizer()
+        san.guard_write(self._stage_inputs, "runner._stage_rows/inputs")
+        san.guard_write(self._stage_status, "runner._stage_rows/status")
         for i, a in enumerate(adv):
             self._stage_inputs[i] = a.inputs
             self._stage_status[i] = a.status
         # the buffers are rewritten next tick: commit synchronously so the
         # in-flight upload can never read the next tick's bytes
-        from .utils.staging import commit
-
+        commit = staging.commit
         return commit(self._stage_inputs[:k]), commit(self._stage_status[:k])
 
     def _stage_packed_rows(self, adv: List[AdvanceRequest], start_frame: int,

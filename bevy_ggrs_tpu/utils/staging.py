@@ -12,11 +12,155 @@ widest window is the first dispatch's compile stall).
 dependent computation) completes, so the caller may immediately reuse the
 host buffer while the dispatch itself stays fully asynchronous.  Every
 reused staging buffer — packed or three-upload — must pass through here
-before it reaches a jitted program."""
+before it reaches a jitted program.
+
+``BGT_SANITIZE=1`` arms the :class:`TransferSanitizer`: commits
+version-stamp their backing host buffer, rotation landings clear the
+stamp, and every rewrite funnel (``pack_prefix``, the census row stagers)
+asks permission first — a rewrite of a still-in-flight buffer raises
+:class:`TransferRaceError` at the exact racing write instead of
+corrupting an upload.  Donated arrays (``jax.jit(...,
+donate_argnums=...)`` recycle paths) get the same treatment through
+:meth:`TransferSanitizer.donate` / :meth:`~TransferSanitizer.
+guard_donated`.  Disabled (the default), every hook is a single
+attribute-check no-op off the hot path's critical arithmetic; the
+``stage_uploads`` bench arm gates both prices."""
 
 from __future__ import annotations
 
+import os
+
 import jax
+
+
+class TransferRaceError(RuntimeError):
+    """A staging buffer or donated array was reused before its transfer
+    landed — the exact silent-corruption race the static BGT063 rule and
+    this runtime sanitizer exist to catch."""
+
+
+class TransferSanitizer:
+    """Version-stamp ledger for in-flight host->device transfers.
+
+    The ledger keys on ``id()`` of the *backing* buffer (``_base`` walks
+    the numpy ``.base`` chain, so committing ``buf[:k]`` and rewriting
+    ``buf`` meet on the same key).  Donated arrays live in a separate
+    insertion-ordered table trimmed to the newest ``_DONATED_CAP`` entries
+    — a bounded window is the honest contract for an ``id()``-keyed table
+    (a freed array's id can be recycled by the allocator; keeping the
+    table short keeps the false-alarm window shorter than any real
+    recycle cadence, which revisits a key every wave).
+
+    Every public method early-returns on ``self.enabled`` — that single
+    boolean check is the entire disabled-path cost, gated under 1.5us per
+    packed tick by the ``stage_uploads`` bench arm."""
+
+    _DONATED_CAP = 64
+
+    def __init__(self, enabled=None):
+        if enabled is None:
+            enabled = os.environ.get("BGT_SANITIZE", "") == "1"
+        self.enabled = bool(enabled)
+        self.violations = 0
+        self._versions = 0
+        self._inflight = {}  # id(base) -> (version, note)
+        self._donated = {}  # id(arr) -> note, insertion-ordered
+
+    @staticmethod
+    def _base(buf):
+        while getattr(buf, "base", None) is not None:
+            buf = buf.base
+        return buf
+
+    def _violate(self, rule, msg):
+        self.violations += 1
+        from .. import telemetry
+
+        telemetry.count(
+            "sanitizer_violations_total",
+            help="transfer races caught by the BGT_SANITIZE runtime "
+                 "sanitizer, by rule",
+            rule=rule,
+        )
+        raise TransferRaceError(msg)
+
+    def begin(self, buf, note=""):
+        """A transfer of ``buf`` is now in flight: stamp its backing."""
+        if not self.enabled:
+            return
+        self._versions += 1
+        self._inflight[id(self._base(buf))] = (self._versions, note)
+
+    def land(self, buf):
+        """The transfer consuming ``buf`` has landed: clear the stamp."""
+        if not self.enabled:
+            return
+        self._inflight.pop(id(self._base(buf)), None)
+
+    def guard_write(self, buf, site=""):
+        """Called by every staging rewrite funnel before touching ``buf``."""
+        if not self.enabled:
+            return
+        entry = self._inflight.get(id(self._base(buf)))
+        if entry is not None:
+            version, note = entry
+            self._violate(
+                "staging_reuse",
+                f"staging buffer rewrite at {site or '<unknown>'} while "
+                f"upload #{version}{f' ({note})' if note else ''} is still "
+                "in flight — acquire() the rotation (or block on the "
+                "commit) before rewriting",
+            )
+
+    def donate(self, arr, note=""):
+        """``arr`` was donated to a jitted call: reads now alias freed
+        device memory until the owner rebinds it."""
+        if not self.enabled or arr is None:
+            return
+        self._donated[id(arr)] = note
+        while len(self._donated) > self._DONATED_CAP:
+            self._donated.pop(next(iter(self._donated)))
+
+    def guard_donated(self, arr, site=""):
+        """Called before handing ``arr`` back into a dispatch."""
+        if not self.enabled or arr is None:
+            return
+        note = self._donated.get(id(arr))
+        if note is not None:
+            self._violate(
+                "donated_reuse",
+                f"donated array reused at {site or '<unknown>'}"
+                f"{f' ({note})' if note else ''} — it was consumed by a "
+                "donate_argnums dispatch and must be rebound from the "
+                "call result",
+            )
+
+    def undonate(self, arr):
+        """``arr``'s slot was legitimately rebound: forget the donation."""
+        if not self.enabled or arr is None:
+            return
+        self._donated.pop(id(arr), None)
+
+    def reset(self):
+        self._inflight.clear()
+        self._donated.clear()
+        self.violations = 0
+
+
+_SANITIZER = TransferSanitizer()
+
+
+def sanitizer() -> TransferSanitizer:
+    """The process sanitizer — callers must fetch it per use (not cache
+    it) so :func:`set_sanitize` test swaps take effect."""
+    return _SANITIZER
+
+
+def set_sanitize(enabled: bool) -> TransferSanitizer:
+    """Swap in a fresh sanitizer (test hook; mirrors BGT_SANITIZE=1)."""
+    global _SANITIZER
+    _SANITIZER = TransferSanitizer(enabled=enabled)
+    return _SANITIZER
 
 
 def commit(buf, sharding=None):
@@ -27,6 +171,9 @@ def commit(buf, sharding=None):
     # commit stays resident until the dispatch consumes it (one dict store
     # — see telemetry/devmem.py's cost posture)
     devmem.note("staging/last_commit", getattr(buf, "nbytes", 0))
+    san = _SANITIZER
+    san.guard_write(buf, "staging.commit")  # a racing PRIOR upload of buf
+    san.begin(buf, "staging.commit")
     x = (
         jax.device_put(buf, sharding)
         if sharding is not None
@@ -35,6 +182,7 @@ def commit(buf, sharding=None):
     # bgt: ignore[BGT011]: deliberate — blocks on the TRANSFER only, which
     # is what makes persistent staging-buffer reuse safe (module docstring)
     x.block_until_ready()
+    san.land(buf)
     return x
 
 
@@ -81,6 +229,9 @@ class StagingQueue:
                 # upload from `depth` acquires ago is still in flight
                 old.block_until_ready()
             self._inflight[self._idx] = None
+        # either branch proved the old upload landed: clear its stamp so
+        # the caller's rewrite passes the sanitizer
+        _SANITIZER.land(self.buffers[self._idx])
         return self.buffers[self._idx]
 
     def commit(self, view):
@@ -89,6 +240,12 @@ class StagingQueue:
         from ..telemetry import devmem
 
         devmem.note("staging/last_commit", getattr(view, "nbytes", 0))
+        _SANITIZER.begin(view, "StagingQueue.commit")
+        # bgt: ignore[BGT063]: rotation protocol — buffer i is rewritten
+        # only `depth` acquires later, and acquire() blocks on this very
+        # upload iff it has not landed by then (depth >= 2 enforced in
+        # __init__); the sanitizer's begin/land stamps enforce the same
+        # contract at runtime under BGT_SANITIZE=1
         x = jax.device_put(view)
         self._inflight[self._idx] = x
         return x

@@ -4,9 +4,13 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== determinism analyzer (hard gate; JSON report next to bench artifacts) =="
-# 19 rules: hygiene, intra- + interprocedural hot-loop purity, phase-timer
-# discipline, metric/rule docs cross-checks, determinism hazards — see
-# docs/static-analysis.md; scripts/lint_imports.py remains as a thin shim
+# 26 rules: hygiene, intra- + interprocedural hot-loop purity, phase-timer
+# discipline, metric/rule docs cross-checks, determinism hazards, and the
+# BGT06x concurrency/transfer-race block (shared-state locking, blocking-
+# under-lock, lock ordering, staging/donation races) — see
+# docs/static-analysis.md; scripts/lint_imports.py remains as a thin shim.
+# `python -m scripts.lint --changed` is the fast pre-commit slice; this
+# full run stays the authoritative gate
 python -m scripts.lint --json LINT_report.json
 
 echo "== native build + tests =="
@@ -43,7 +47,9 @@ echo "== bench smoke (batched + sharded + netstats + uploads + speculation + tra
 # fleet stage runs a real 2-worker fleet and hard-fails on any desync after
 # live migration or SIGKILL failover, a failover that did not resume from
 # the last confirmed checkpoint, or an admission reject that is not
-# wire-visible
+# wire-visible; the uploads stage additionally hard-fails unless the
+# BGT_SANITIZE transfer sanitizer costs <2% of the packed tick armed and
+# <1.5us disarmed
 python bench.py --smoke
 
 echo "== bench =="

@@ -62,6 +62,62 @@ METRIC_DOCS = "docs/observability.md"
 # -- rule-id <-> docs-catalog cross-check ------------------------------------
 RULE_DOCS = "docs/static-analysis.md"
 
+# -- concurrency scope (BGT060/061/062) --------------------------------------
+# Threaded (or thread-adjacent) control-plane modules: the attribute/lock
+# map and the blocking-under-lock / lock-order checks run only here.  The
+# fleet modules are poll-driven single-threaded TODAY, but they are the
+# modules a future thread would be added to — covering them now means the
+# rule fires on the PR that adds the thread, not three PRs later.
+CONCURRENCY_MODULES: Tuple[str, ...] = (
+    "bevy_ggrs_tpu/fleet/worker.py",
+    "bevy_ggrs_tpu/fleet/scheduler.py",
+    "bevy_ggrs_tpu/fleet/protocol.py",
+    "bevy_ggrs_tpu/telemetry/metrics.py",
+    "bevy_ggrs_tpu/telemetry/prometheus.py",
+    "scripts/room_server.py",
+)
+
+# module suffix -> extra background-thread entry points ("Cls.method" /
+# bare function qualnames).  Thread(target=...) functions and do_* methods
+# of HTTP handler classes are detected automatically; this covers
+# CROSS-module entries the per-module scan cannot see — the Prometheus
+# exporter's scrape threads call straight into the metric mutators.
+THREAD_ROOTS: Dict[str, Set[str]] = {
+    "bevy_ggrs_tpu/telemetry/metrics.py": {
+        "Counter.inc", "Gauge.set", "Gauge.set_key", "Gauge.inc",
+        "Histogram.observe", "Histogram.observe_key",
+        "_Metric.series", "MetricsRegistry._get_or_create",
+        "MetricsRegistry.metrics", "MetricsRegistry.render_prometheus",
+    },
+}
+
+# calls that can block the holder of a lock (BGT061): attribute names
+# (socket/array sync surface) and dotted prefixes (module calls)
+BLOCKING_CALL_ATTRS = frozenset({
+    "recvfrom", "recv", "accept", "connect", "sendall",
+    "block_until_ready", "join",
+})
+BLOCKING_CALL_DOTTED: Tuple[str, ...] = (
+    "time.sleep", "subprocess.", "select.select", "socket.create_connection",
+)
+
+# -- transfer-race scope (BGT063) --------------------------------------------
+# files whose UNBARRIERED jax.device_put calls are findings by themselves
+# (the staging funnels: every reused-buffer upload is routed through here,
+# so an unbarriered upload inside one is the PR 8 hazard by construction).
+# Elsewhere, an unbarriered upload only becomes a finding when a reused
+# staging buffer provably flows into it through the call graph.
+TRANSFER_GUARD_FILES: Tuple[str, ...] = (
+    "bevy_ggrs_tpu/utils/staging.py",
+)
+# constructors whose result counts as a persistent host staging buffer
+# when assigned to an attribute that is also subscript-written
+STAGING_FACTORY_NAMES = frozenset({
+    "empty", "zeros", "ones", "full", "frombuffer", "empty_like",
+    "zeros_like",
+})
+STAGING_FACTORY_ATTRS = frozenset({"new_buffer", "new_batch_buffer"})
+
 # -- determinism-hazard scopes -----------------------------------------------
 # step/sim code: the only places wall-clock reads, jitted debug callbacks
 # and frozen-world mutation are hazards *by construction* (session code
@@ -92,6 +148,31 @@ class Config:
     # project-level cross-checks (metrics/docs/stale-allowlist) only make
     # sense against the real repo; fixture runs turn them off
     project_checks: bool = True
+    concurrency_modules: Tuple[str, ...] = CONCURRENCY_MODULES
+    thread_roots: Dict[str, Set[str]] = dataclasses.field(
+        default_factory=lambda: {k: set(v) for k, v in THREAD_ROOTS.items()}
+    )
+    blocking_call_attrs: frozenset = BLOCKING_CALL_ATTRS
+    blocking_call_dotted: Tuple[str, ...] = BLOCKING_CALL_DOTTED
+    transfer_guard_files: Tuple[str, ...] = TRANSFER_GUARD_FILES
+    staging_factory_names: frozenset = STAGING_FACTORY_NAMES
+    staging_factory_attrs: frozenset = STAGING_FACTORY_ATTRS
+    # True for `--changed` runs: the corpus is a changed-files slice, so
+    # reverse (stale-entry) docs checks and the stale-suppression
+    # meta-rule would false-positive on everything the slice omits
+    partial_corpus: bool = False
+
+    def in_concurrency_scope(self, rel: str) -> bool:
+        return any(rel.endswith(suffix) for suffix in self.concurrency_modules)
+
+    def thread_roots_for(self, rel: str) -> Set[str]:
+        for suffix, roots in self.thread_roots.items():
+            if rel.endswith(suffix):
+                return roots
+        return set()
+
+    def is_transfer_guard_file(self, rel: str) -> bool:
+        return any(rel.endswith(suffix) for suffix in self.transfer_guard_files)
 
     def purity_allowlist_for(self, rel: str):
         """The allowlist for ``rel`` if the purity rules cover it, else None."""

@@ -66,6 +66,11 @@ class Rule:
 
 RULES: Dict[str, Rule] = {}
 PASSES: List[Callable] = []
+# post passes run AFTER the normal passes and suppression application —
+# they see the (suppressed-marked) findings, so meta-rules like the
+# stale-suppression check (BGT005) can reason about which suppressions
+# actually did something this run
+POST_PASSES: List[Callable] = []
 
 _RULE_ID_RE = re.compile(r"^BGT0\d\d$")
 
@@ -86,6 +91,13 @@ def rule(id: str, name: str, severity: str = "error", summary: str = "") -> Rule
 def lint_pass(fn: Callable) -> Callable:
     """Decorator: register ``fn(ctx) -> list[Finding]`` as an analysis pass."""
     PASSES.append(fn)
+    return fn
+
+
+def post_pass(fn: Callable) -> Callable:
+    """Decorator: register ``fn(ctx, findings) -> list[Finding]`` to run
+    after every normal pass and after suppressions were applied."""
+    POST_PASSES.append(fn)
     return fn
 
 
@@ -127,16 +139,14 @@ class Finding:
 _IGNORE_RE = re.compile(r"#\s*bgt:\s*ignore\[([A-Za-z0-9_,\s]+)\](?::\s*(.*))?")
 
 
-def parse_suppressions(src: str):
-    """Map ``line -> {rule_id: reason}`` for every ``# bgt: ignore[...]``
-    comment, plus ``(line, bad_id)`` pairs for unknown rule ids.
+def iter_suppression_origins(src: str):
+    """Yield ``(origin_line, ids, reason, targets)`` per ignore comment.
 
-    A suppression covers its own physical line; when the comment is the
-    *whole* line (a standalone comment), it extends through the rest of
-    that comment block to the first code line below it, so a multi-line
-    justification can sit above a long statement."""
-    covers: Dict[int, Dict[str, str]] = {}
-    unknown: List[Tuple[int, str]] = []
+    ``ids`` keeps unknown rule ids (the caller decides what to do with
+    them); ``targets`` is every line the comment covers: its own physical
+    line, and — when the comment is the *whole* line (standalone) — the
+    rest of that comment block through the first code line below it, so a
+    multi-line justification can sit above a long statement."""
     lines = src.splitlines()
     for lineno, line in enumerate(lines, start=1):
         m = _IGNORE_RE.search(line)
@@ -152,6 +162,15 @@ def parse_suppressions(src: str):
                 targets.append(nxt)
                 nxt += 1
             targets.append(nxt)
+        yield lineno, ids, reason, targets
+
+
+def parse_suppressions(src: str):
+    """Map ``line -> {rule_id: reason}`` for every ``# bgt: ignore[...]``
+    comment, plus ``(line, bad_id)`` pairs for unknown rule ids."""
+    covers: Dict[int, Dict[str, str]] = {}
+    unknown: List[Tuple[int, str]] = []
+    for lineno, ids, reason, targets in iter_suppression_origins(src):
         for rid in ids:
             if rid not in RULES:
                 unknown.append((lineno, rid))
@@ -195,6 +214,11 @@ class Context:
     root: Path
     files: List[SourceFile]
     config: "object" = None  # scripts.lint.config.Config, set by run()
+    # (rel, line, rule_id) of suppressions a pass consumed WITHOUT leaving
+    # a suppressed finding behind — seed-line sanctions like BGT011/BGT063,
+    # which stop an effect from propagating.  The stale-suppression
+    # meta-rule (BGT005) treats these as live.
+    used_suppressions: set = dataclasses.field(default_factory=set)
 
     def by_suffix(self, suffix: str) -> Optional[SourceFile]:
         for f in self.files:
@@ -286,6 +310,13 @@ def run(paths=None, root: Optional[Path] = None, config=None) -> Tuple[List[Find
     for p in PASSES:
         findings.extend(p(ctx))
     apply_suppressions(findings, files)
+    # post passes (the stale-suppression meta-rule) see the suppressed-
+    # marked findings; their own findings are suppressible too
+    extra: List[Finding] = []
+    for p in POST_PASSES:
+        extra.extend(p(ctx, findings))
+    apply_suppressions(extra, files)
+    findings.extend(extra)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, files
 
@@ -322,6 +353,16 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", metavar="FILE", help="write current findings as a baseline")
     ap.add_argument("--show-suppressed", action="store_true", help="also print suppressed findings")
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog and exit")
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs --changed-base plus their "
+             "import-graph dependents (fast pre-commit path; check.sh "
+             "keeps the authoritative full run)",
+    )
+    ap.add_argument(
+        "--changed-base", metavar="REF", default="HEAD",
+        help="git ref --changed diffs against (default: HEAD)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -331,7 +372,24 @@ def main(argv=None) -> int:
             print(f"{r.id}  {r.severity:7s}  {r.name}: {r.summary}")
         return 0
 
-    findings, _files = run(args.paths or None)
+    if args.changed:
+        from .config import Config
+        from .incremental import changed_corpus
+
+        paths, changed = changed_corpus(_find_root(), base=args.changed_base)
+        if not paths:
+            print("lint: --changed found no changed python files")
+            return 0
+        print(
+            f"lint: --changed vs {args.changed_base}: {len(changed)} changed "
+            f"file(s) -> {len(paths)} with dependents"
+        )
+        # a partial corpus cannot support the reverse (stale-entry) docs
+        # checks or the stale-suppression meta-rule without false
+        # positives; the full run in check.sh keeps those armed
+        findings, _files = run(paths, config=Config(partial_corpus=True))
+    else:
+        findings, _files = run(args.paths or None)
 
     if args.baseline:
         known = load_baseline(Path(args.baseline))

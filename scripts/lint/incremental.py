@@ -1,0 +1,152 @@
+"""Incremental linting — the ``--changed`` fast path.
+
+``python -m scripts.lint --changed`` lints only the python files ``git
+diff`` reports against a base ref (default HEAD: unstaged + staged +
+untracked), EXPANDED to every module that transitively imports one of
+them, so interprocedural rules (BGT011/BGT063 chains resolve through the
+importer) and per-file rules both see the same code they would in a full
+run.  What a partial corpus structurally cannot support — the reverse
+docs checks (BGT022/BGT031/BGT033/BGT051) and the stale-suppression
+meta-rule (BGT005), which need the WHOLE repo to prove absence — is
+turned off via ``Config.partial_corpus``; ``scripts/check.sh`` keeps the
+authoritative full run.
+
+The import graph is built the same way the purity call graph resolves
+modules: stdlib AST only, dotted names mapped to repo-relative paths,
+relative imports anchored at the importing file's package.  Conservative
+by design: unresolvable imports simply add no edge, which can only make
+the expansion smaller — never wrong for the files it does include.
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+from pathlib import Path, PurePosixPath
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .core import DEFAULT_PATHS, iter_py_files
+
+
+def git_changed_files(root: Path, base: str = "HEAD") -> Set[str]:
+    """Repo-relative posix paths of files changed vs ``base`` (worktree +
+    index) plus untracked files; empty set when git is unavailable."""
+    changed: Set[str] = set()
+    for args in (
+        ["git", "diff", "--name-only", base],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return set()
+        if res.returncode != 0:
+            continue
+        changed.update(
+            line.strip() for line in res.stdout.splitlines() if line.strip()
+        )
+    return changed
+
+
+def _module_candidates(dotted: str) -> List[str]:
+    """Possible repo-relative paths for a dotted module name."""
+    base = dotted.replace(".", "/")
+    return [base + ".py", base + "/__init__.py"]
+
+
+def _file_dotted(rel: str) -> str:
+    """The dotted module name a repo-relative path is importable as."""
+    p = PurePosixPath(rel)
+    parts = list(p.parts)
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+def _imports_of(rel: str, tree: ast.AST, known: Set[str]) -> Set[str]:
+    """Repo-relative paths (from ``known``) that ``rel`` imports."""
+    out: Set[str] = set()
+    self_dotted = _file_dotted(rel)
+    is_pkg = rel.endswith("__init__.py")
+
+    def add_module(dotted: str) -> bool:
+        for cand in _module_candidates(dotted):
+            if cand in known:
+                out.add(cand)
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                # `import a.b.c` binds a but loads a, a.b and a.b.c
+                parts = a.name.split(".")
+                for i in range(len(parts)):
+                    add_module(".".join(parts[: i + 1]))
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                anchor = self_dotted.split(".")
+                drop = node.level - 1 if is_pkg else node.level
+                if drop:
+                    anchor = anchor[: len(anchor) - drop]
+                base = ".".join(
+                    anchor + (node.module.split(".") if node.module else [])
+                )
+            if not base:
+                continue
+            add_module(base)
+            for a in node.names:
+                if a.name != "*":
+                    add_module(f"{base}.{a.name}")
+    return out
+
+
+def build_reverse_import_graph(root: Path) -> Tuple[Set[str], Dict[str, Set[str]]]:
+    """``(corpus_rels, imported_rel -> {importer_rel, ...})`` over the
+    default lint corpus (fixtures excluded, same as a full run)."""
+    files = iter_py_files(DEFAULT_PATHS, root)
+    rels: List[Tuple[str, Path]] = []
+    for p in files:
+        try:
+            rel = p.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        rels.append((rel, p))
+    known = {rel for rel, _ in rels}
+    reverse: Dict[str, Set[str]] = {}
+    for rel, p in rels:
+        try:
+            tree = ast.parse(p.read_text())
+        except (OSError, SyntaxError):
+            continue
+        for dep in _imports_of(rel, tree, known):
+            if dep != rel:
+                reverse.setdefault(dep, set()).add(rel)
+    return known, reverse
+
+
+def expand_dependents(changed: Iterable[str], root: Path) -> List[str]:
+    """The changed .py files that exist in the lint corpus, plus every
+    transitive importer — sorted repo-relative paths."""
+    known, reverse = build_reverse_import_graph(root)
+    work = [c for c in changed if c.endswith(".py") and c in known]
+    seen: Set[str] = set(work)
+    while work:
+        cur = work.pop()
+        for importer in reverse.get(cur, ()):
+            if importer not in seen:
+                seen.add(importer)
+                work.append(importer)
+    return sorted(seen)
+
+
+def changed_corpus(root: Path, base: str = "HEAD") -> Tuple[List[str], Set[str]]:
+    """``(paths_to_lint, raw_changed_set)`` for the --changed CLI mode."""
+    changed = git_changed_files(root, base=base)
+    return expand_dependents(changed, root), changed

@@ -11,6 +11,7 @@ catalog all key on them):
 - ``BGT03x`` metric-name and trace-kind <-> docs-catalog cross-checks
 - ``BGT04x`` determinism hazards in step/model/session code
 - ``BGT05x`` rule-id <-> docs-catalog cross-check
+- ``BGT06x`` concurrency & transfer races in the control plane
 """
 
 from . import imports  # noqa: F401
@@ -20,3 +21,7 @@ from . import metrics  # noqa: F401
 from . import trace_kinds  # noqa: F401
 from . import determinism  # noqa: F401
 from . import docs  # noqa: F401
+from . import shared_state  # noqa: F401
+from . import locks  # noqa: F401
+from . import lock_order  # noqa: F401
+from . import transfer_race  # noqa: F401

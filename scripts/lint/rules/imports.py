@@ -8,7 +8,15 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..core import Context, Finding, lint_pass, rule
+from ..core import (
+    RULES,
+    Context,
+    Finding,
+    iter_suppression_origins,
+    lint_pass,
+    post_pass,
+    rule,
+)
 
 rule(
     "BGT001", "unused-import",
@@ -25,6 +33,11 @@ rule(
 rule(
     "BGT004", "unknown-suppression",
     summary="a '# bgt: ignore[...]' comment names a rule id that does not exist",
+)
+rule(
+    "BGT005", "stale-suppression",
+    summary="a '# bgt: ignore[...]' comment whose rule no longer fires on "
+            "any line it covers — the suppression inventory rotted",
 )
 
 # re-export / intentional-import conventions that must not be flagged
@@ -116,4 +129,49 @@ def hygiene_pass(ctx: Context) -> List[Finding]:
             out.append(Finding("BGT001", f.rel, line, msg))
         for line, msg in check_duplicate_defs(f.tree):
             out.append(Finding("BGT002", f.rel, line, msg))
+    return out
+
+
+@post_pass
+def stale_suppression_pass(ctx: Context, findings: List[Finding]) -> List[Finding]:
+    """BGT005 — the BGT012 stale-allowlist idea generalized to EVERY rule:
+    an ignore comment is live only if its rule actually fired (and was
+    suppressed) on a covered line this run, or a pass consumed it as a
+    seed-line sanction (``ctx.used_suppressions`` — the BGT011/BGT063
+    shape, where the sanction prevents the finding from ever existing).
+
+    Skipped for partial corpora (``--changed``): a slice run cannot prove
+    a project-level rule would not have fired."""
+    cfg = ctx.config
+    if getattr(cfg, "partial_corpus", False):
+        return []
+    hits = {(f.path, f.line, f.rule) for f in findings if f.suppressed}
+    hits |= set(ctx.used_suppressions)
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.syntax_error is not None:
+            continue  # no rules ran: staleness is unknowable
+        # ignore-syntax *examples* inside docstrings (this very framework
+        # documents itself) are not suppressions — skip string-literal lines
+        doc_lines: set = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                end = getattr(node, "end_lineno", None) or node.lineno
+                doc_lines.update(range(node.lineno, end + 1))
+        for origin, ids, _reason, targets in iter_suppression_origins(sf.source):
+            if origin in doc_lines:
+                continue
+            for rid in ids:
+                if rid not in RULES:
+                    continue  # a BGT004 finding already covers the typo
+                if rid == "BGT005":
+                    continue  # self-referential: suppresses THIS rule here
+                if any((sf.rel, t, rid) in hits for t in targets):
+                    continue
+                out.append(Finding(
+                    "BGT005", sf.rel, origin,
+                    f"stale suppression: {rid} no longer fires on any line "
+                    "this comment covers — delete the ignore (or fix the "
+                    "regression that was hiding behind it)",
+                ))
     return out

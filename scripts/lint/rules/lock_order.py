@@ -1,0 +1,63 @@
+"""Inconsistent lock acquisition order — BGT062.
+
+Two locks taken as ``A then B`` on one code path and ``B then A`` on
+another is the textbook ABBA deadlock, and it is invisible to every test
+that doesn't lose the exact race.  The module scanner already records the
+nesting order of textual lock paths per function (a ``with a:`` lexically
+enclosing a ``with b:``, including multi-item ``with a, b:`` which
+acquires left-to-right); this pass merges those orders module-wide and
+flags every pair witnessed in both directions, naming both witness sites
+so the fix — pick one canonical order and rewrite the minority site — is
+mechanical.
+
+Lock identity is the dotted source path (``self._lock``), same textual
+witness as BGT060: two different objects that happen to share a spelling
+could false-positive, but in this codebase lock spellings are unique per
+class and the modules in scope are small; suppress with the aliasing
+argument if that ever changes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..core import Context, Finding, lint_pass, rule
+from .shared_state import scan_module
+
+rule(
+    "BGT062", "inconsistent-lock-order",
+    summary="two locks are acquired in opposite nesting orders on "
+            "different code paths — the classic ABBA deadlock",
+)
+
+
+@lint_pass
+def lock_order_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        if not cfg.in_concurrency_scope(sf.rel):
+            continue
+        mmap = scan_module(sf, cfg)
+        # (A, B) -> [(qual, line)] witnesses of "A held when B acquired"
+        orders: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        for qual, fi in mmap.funcs.items():
+            for outer, inner, line in fi.lock_orders:
+                orders.setdefault((outer, inner), []).append((qual, line))
+        reported = set()
+        for (a, b), sites in sorted(orders.items()):
+            if (b, a) not in orders or frozenset((a, b)) in reported:
+                continue
+            reported.add(frozenset((a, b)))
+            qual, line = min(sites, key=lambda s: s[1])
+            rqual, rline = min(orders[(b, a)], key=lambda s: s[1])
+            out.append(Finding(
+                "BGT062", sf.rel, line,
+                f"inconsistent lock order: {qual} (line {line}) acquires "
+                f"{a} then {b}, but {rqual} (line {rline}) acquires "
+                f"{b} then {a} — pick one canonical order; two threads "
+                "taking these paths concurrently deadlock",
+            ))
+    return out

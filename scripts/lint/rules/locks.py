@@ -1,0 +1,54 @@
+"""Blocking call under a held lock — BGT061.
+
+The control-plane locks exist to protect microsecond-scale map mutations
+(a metrics series write, a pending-table insert).  A blocking call made
+while one is held — ``sock.recvfrom`` with a timeout, ``time.sleep``, a
+``block_until_ready`` device sync, ``Thread.join`` — turns every other
+thread that touches the lock into a hostage of that wait: the Prometheus
+scrape thread stalls the tick loop, or worse, a join-under-lock deadlocks
+against the thread it is joining.  The rule is scoped to the concurrency
+modules (``config.CONCURRENCY_MODULES``) and keys on the call shape
+(attribute names in ``config.BLOCKING_CALL_ATTRS``, dotted prefixes in
+``config.BLOCKING_CALL_DOTTED``) — no type inference, which is the right
+trade for a stdlib linter: the listed names are unambiguous in this
+codebase (nothing else defines a ``recvfrom``).
+
+Fix: copy what you need under the lock, drop it, then block — or
+suppress with the reason the wait is bounded and the lock is private.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Context, Finding, lint_pass, rule
+from .shared_state import scan_module
+
+rule(
+    "BGT061", "blocking-call-under-lock",
+    summary="a blocking call (socket/sleep/subprocess/device-sync/join) "
+            "made while a lock is held stalls every thread that shares it",
+)
+
+
+@lint_pass
+def blocking_under_lock_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        if not cfg.in_concurrency_scope(sf.rel):
+            continue
+        mmap = scan_module(sf, cfg)
+        for qual, fi in sorted(mmap.funcs.items()):
+            for line, call_repr, held in fi.blocking:
+                locks = ", ".join(sorted(held))
+                out.append(Finding(
+                    "BGT061", sf.rel, line,
+                    f"blocking call under lock: {qual} calls "
+                    f"{call_repr}(...) while holding {locks} — every "
+                    "thread sharing that lock stalls for the full wait; "
+                    "copy state under the lock, release it, then block",
+                ))
+    return out

@@ -116,7 +116,10 @@ def metrics_pass(ctx: Context) -> List[Finding]:
     # a partial-path run must not call a row stale just because the file
     # that registers it was not linted (same guard as the BGT022 reverse
     # check); the package __init__ in the corpus is the full-run proxy
-    full_corpus = ctx.by_suffix(cfg.package_dir + "/__init__.py") is not None
+    full_corpus = (
+        ctx.by_suffix(cfg.package_dir + "/__init__.py") is not None
+        and not getattr(cfg, "partial_corpus", False)
+    )
     if full_corpus:
         for name in sorted(doc_names - code_names):
             out.append(Finding(

@@ -147,7 +147,8 @@ def phases_pass(ctx: Context) -> List[Finding]:
     # the reverse (stale-catalog) check needs the FULL driver set in the
     # corpus — a partial-path run must not call a phase stale just because
     # the driver that times it was not linted
-    if cfg.project_checks and len(drivers_seen) == len(cfg.phase_files):
+    if (cfg.project_checks and len(drivers_seen) == len(cfg.phase_files)
+            and not getattr(cfg, "partial_corpus", False)):
         for name in sorted(catalog - used_anywhere):
             out.append(Finding(
                 "BGT022", cfg.phases_module, 0,

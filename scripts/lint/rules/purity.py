@@ -171,11 +171,15 @@ def _resolve_import_module(cur_dotted: str, is_pkg: bool,
 class _Collector(ast.NodeVisitor):
     """One module's functions, classes, imports and raw call refs."""
 
-    def __init__(self, mod: _Module, sf: SourceFile, attrs, names):
+    def __init__(self, mod: _Module, sf: SourceFile, attrs, names,
+                 used: Optional[set] = None):
         self.mod = mod
         self.sf = sf
         self.attrs = attrs
         self.names = names
+        # (rel, line, rule) sink for consumed seed-line sanctions, so the
+        # stale-suppression meta-rule (BGT005) knows they are load-bearing
+        self.used = used if used is not None else set()
         self._stack: List[str] = []  # qualname segments
         self._cls: List[Optional[str]] = []
 
@@ -243,9 +247,13 @@ class _Collector(ast.NodeVisitor):
         if isinstance(node, ast.Attribute) and node.attr in self.attrs:
             if "BGT011" not in self.sf.suppressions.get(node.lineno, {}):
                 fn.direct.append((node.lineno, f".{node.attr}"))
+            else:
+                self.used.add((self.sf.rel, node.lineno, "BGT011"))
         elif isinstance(node, ast.Name) and node.id in self.names:
             if "BGT011" not in self.sf.suppressions.get(node.lineno, {}):
                 fn.direct.append((node.lineno, node.id))
+            else:
+                self.used.add((self.sf.rel, node.lineno, "BGT011"))
         if not isinstance(node, ast.Call):
             return
         f = node.func
@@ -287,7 +295,10 @@ class CallGraph:
                 dotted=_dotted(sf.rel, package_parent),
                 is_pkg=sf.rel.endswith("__init__.py"),
             )
-            _Collector(mod, sf, cfg.purity_attrs, cfg.purity_names).visit(sf.tree)
+            _Collector(
+                mod, sf, cfg.purity_attrs, cfg.purity_names,
+                used=ctx.used_suppressions,
+            ).visit(sf.tree)
             self.modules[mod.dotted] = mod
             self.by_rel[sf.rel] = mod
         # unique-name index over methods AND functions for the fallback

@@ -1,0 +1,327 @@
+"""Cross-thread shared state — BGT060.
+
+The fleet control plane (PR 11) and the telemetry exporter are the only
+places this repo runs (or is one ``threading.Thread`` away from running)
+concurrent code, and the determinism rules are blind to them: a mutable
+attribute written from a scrape thread AND the tick loop with no common
+lock is a data race that no SyncTest oracle will ever catch — it shows up
+as a corrupted heartbeat or a torn metrics series once per ten thousand
+scrapes.  BGT060 builds a per-class attribute/lock map over the modules
+in ``config.CONCURRENCY_MODULES``:
+
+- **background entry points** are detected (``threading.Thread(target=
+  ...)`` targets, ``do_*`` methods of HTTP handler classes) or declared
+  (``config.THREAD_ROOTS`` — cross-module entries like the Prometheus
+  scrape threads calling straight into ``Gauge.set``);
+- every function reachable from a background root is *background*; every
+  function not reachable ONLY from thread-only roots is *foreground*
+  (declared roots are public API, so they count as both);
+- an attribute written (rebound or subscript-mutated through ``self.X``)
+  from both worlds must hold one **common lock** — a ``with <lock>:``
+  whose expression names the same dotted path — at every write site
+  outside ``__init__`` (construction happens-before ``Thread.start``).
+
+The lock witness is textual (``self._reg._lock`` == ``self._reg._lock``)
+— no alias analysis, which is exactly as strong as the repo's lock idiom
+(locks live on ``self``/one hop down and are acquired with ``with``).
+Explicit ``.acquire()``/``.release()`` pairing is NOT modeled; rewrite to
+``with`` or suppress with the protocol that replaces the lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+
+rule(
+    "BGT060", "unlocked-shared-attribute",
+    summary="attribute written from both a background thread and the "
+            "foreground with no common lock held at every write site",
+)
+
+# a with-expression is a lock witness when its last path segment looks
+# like one — matches the repo idiom (_lock on the registry, per-object
+# locks named `lock`) without resolving types
+_LOCK_NAME_RE = re.compile(r"(^|_)(lock|mutex|rlock|cond|condition)$", re.I)
+
+
+def dotted_path(node: ast.AST) -> Optional[str]:
+    """``self._reg._lock`` -> that string; None for non-Name/Attribute."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_lock_expr(dotted: Optional[str]) -> bool:
+    return bool(dotted) and bool(_LOCK_NAME_RE.search(dotted.rsplit(".", 1)[-1]))
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method of a concurrency-scoped module."""
+
+    qual: str  # dotted qualname (Cls.meth, Outer.__init__.Handler.do_GET)
+    cls: Optional[str]  # nearest enclosing class name
+    lineno: int
+    # attr -> [(line, held_locks frozenset)] for writes through self.attr
+    writes: Dict[str, List[Tuple[int, frozenset]]] = dataclasses.field(
+        default_factory=dict
+    )
+    # local call refs: ("self", name) | ("bare", name) | ("attr", name)
+    calls: List[tuple] = dataclasses.field(default_factory=list)
+    # (outer_lock, inner_lock, line) nesting orders witnessed (BGT062)
+    lock_orders: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+    # (line, call_repr, held_locks) blocking calls under a lock (BGT061)
+    blocking: List[Tuple[int, str, frozenset]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@dataclasses.dataclass
+class ModuleMap:
+    """Everything BGT060/061/062 need about one module."""
+
+    funcs: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    # qualnames that are thread-only entry points (Thread targets, do_*)
+    bg_only_roots: Set[str] = dataclasses.field(default_factory=set)
+    handler_classes: Set[str] = dataclasses.field(default_factory=set)
+
+
+def _first_self_attr(node: ast.AST) -> Optional[str]:
+    """For a store target rooted at ``self``: the first attribute after it
+    (``self.X`` and ``self.X[...]`` and ``self.X.Y = ...`` all -> X)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and chain:
+        return chain[-1]
+    return None
+
+
+class _ModuleScanner(ast.NodeVisitor):
+    """Collect per-function write/lock/call facts for one module."""
+
+    def __init__(self, mmap: ModuleMap, blocking_attrs, blocking_dotted):
+        self.mmap = mmap
+        self.blocking_attrs = blocking_attrs
+        self.blocking_dotted = blocking_dotted
+        self._stack: List[str] = []
+        self._cls: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        base_names = {dotted_path(b) or "" for b in node.bases}
+        if any(n.rsplit(".", 1)[-1].endswith("RequestHandler")
+               for n in base_names):
+            self.mmap.handler_classes.add(node.name)
+        self._stack.append(node.name)
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+        self._stack.pop()
+
+    def _enter_func(self, node):
+        qual = ".".join(self._stack + [node.name])
+        cls = self._cls[-1] if self._cls else None
+        fi = FuncInfo(qual=qual, cls=cls, lineno=node.lineno)
+        self.mmap.funcs[qual] = fi
+        if cls in self.mmap.handler_classes and node.name.startswith("do_"):
+            self.mmap.bg_only_roots.add(qual)
+        self._scan_body(node, fi)
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _enter_func
+
+    # -- statement-level scan with a held-lock stack ------------------------
+    def _scan_body(self, fnode, fi: FuncInfo):
+        def scan(node, held: Tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue  # nested defs get their own FuncInfo
+                inner_held = held
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        d = dotted_path(item.context_expr)
+                        if is_lock_expr(d):
+                            for outer in inner_held:
+                                if outer != d:
+                                    fi.lock_orders.append(
+                                        (outer, d, child.lineno)
+                                    )
+                            inner_held = inner_held + (d,)
+                self._scan_stmt(child, fi, inner_held)
+                scan(child, inner_held)
+
+        scan(fnode, ())
+
+    def _scan_stmt(self, node, fi: FuncInfo, held: Tuple[str, ...]):
+        hset = frozenset(held)
+        # writes through self.X (rebind, augmented, subscript/attr store)
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            for el in (t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]):
+                attr = _first_self_attr(el)
+                if attr is not None:
+                    fi.writes.setdefault(attr, []).append((node.lineno, hset))
+        # calls: thread targets, local edges, blocking-under-lock
+        if not isinstance(node, ast.Call):
+            return
+        d = dotted_path(node.func)
+        if d is not None and d.rsplit(".", 1)[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    td = dotted_path(kw.value)
+                    if td is not None:
+                        self.mmap.bg_only_roots.add(
+                            td[5:] if td.startswith("self.") else td
+                        )
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                fi.calls.append(("self", node.func.attr))
+            else:
+                fi.calls.append(("attr", node.func.attr))
+        elif isinstance(node.func, ast.Name):
+            fi.calls.append(("bare", node.func.id))
+        if held and self._is_blocking(node, d):
+            fi.blocking.append((node.lineno, d or "<call>", hset))
+
+    def _is_blocking(self, node: ast.Call, d: Optional[str]) -> bool:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in self.blocking_attrs:
+            return True
+        if d is None:
+            return False
+        return any(
+            d == p or (p.endswith(".") and d.startswith(p))
+            for p in self.blocking_dotted
+        )
+
+
+def scan_module(sf: SourceFile, cfg) -> ModuleMap:
+    mmap = ModuleMap()
+    _ModuleScanner(
+        mmap, cfg.blocking_call_attrs, cfg.blocking_call_dotted
+    ).visit(sf.tree)
+    return mmap
+
+
+def _resolve_local(mmap: ModuleMap, fi: FuncInfo, ref: tuple) -> Optional[str]:
+    """Same-module call-edge resolution, mirroring the purity graph's
+    conservative shapes (self method / module function / unique name)."""
+    kind, name = ref
+    if kind == "self" and fi.cls is not None:
+        # nearest enclosing class wins; handles nested handler classes
+        prefix = fi.qual.rsplit(".", 1)[0]
+        cand = f"{prefix}.{name}"
+        if cand in mmap.funcs:
+            return cand
+    if kind == "bare" and name in mmap.funcs:
+        return name
+    matches = [q for q, f in mmap.funcs.items()
+               if q.rsplit(".", 1)[-1] == name]
+    return matches[0] if len(matches) == 1 else None
+
+
+def _closure(mmap: ModuleMap, roots: Set[str]) -> Set[str]:
+    seen = set(r for r in roots if r in mmap.funcs)
+    work = list(seen)
+    while work:
+        cur = work.pop()
+        for ref in mmap.funcs[cur].calls:
+            tgt = _resolve_local(mmap, mmap.funcs[cur], ref)
+            if tgt is not None and tgt not in seen:
+                seen.add(tgt)
+                work.append(tgt)
+    return seen
+
+
+def partition(mmap: ModuleMap, declared_roots: Set[str]):
+    """``(bg_funcs, fg_funcs)`` qualname sets.  Declared roots are public
+    API (reached from BOTH worlds); detected thread targets / do_* are
+    background-only.  Root spellings (``_scrape`` from a Thread target,
+    ``Cls.meth`` from config) are matched against qualnames by dotted
+    suffix."""
+
+    def match(qual: str, roots) -> bool:
+        return any(qual == r or qual.endswith("." + r) for r in roots)
+
+    bg_only = {q for q in mmap.funcs if match(q, mmap.bg_only_roots)}
+    declared = {q for q in mmap.funcs if match(q, declared_roots)}
+    bg = _closure(mmap, bg_only | declared)
+    fg_roots = {q for q in mmap.funcs if q not in bg_only or q in declared}
+    fg = _closure(mmap, fg_roots)
+    return bg, fg
+
+
+def check_shared_state(sf: SourceFile, cfg) -> List[Finding]:
+    mmap = scan_module(sf, cfg)
+    bg, fg = partition(mmap, cfg.thread_roots_for(sf.rel))
+    if not bg:
+        return []  # no background entry points: nothing is concurrent
+    out: List[Finding] = []
+    # group write sites per (class, attr) across all that class's methods
+    by_attr: Dict[Tuple[str, str], List[Tuple[str, int, frozenset]]] = {}
+    for qual, fi in mmap.funcs.items():
+        if fi.cls is None:
+            continue
+        for attr, sites in fi.writes.items():
+            for line, held in sites:
+                by_attr.setdefault((fi.cls, attr), []).append(
+                    (qual, line, held)
+                )
+    for (cls, attr), sites in sorted(by_attr.items()):
+        live = [s for s in sites
+                if not s[0].rsplit(".", 1)[-1] == "__init__"]
+        if not live:
+            continue  # construction happens-before Thread.start
+        bg_writers = sorted({q for q, _, _ in live if q in bg})
+        fg_writers = sorted({q for q, _, _ in live if q in fg})
+        if not bg_writers or not fg_writers:
+            continue  # one world only: no race
+        common = frozenset.intersection(*[h for _, _, h in live])
+        if common:
+            continue  # a shared lock witnesses every write
+        line = min(l for _, l, _ in live)
+        out.append(Finding(
+            "BGT060", sf.rel, line,
+            f"unlocked shared attribute: {cls}.{attr} is written from a "
+            f"background thread ({', '.join(bg_writers)}) and the "
+            f"foreground ({', '.join(fg_writers)}) with no common lock "
+            "held at every write site — hold one `with <lock>:` around "
+            "every write (or suppress with the protocol that orders them)",
+        ))
+    return out
+
+
+@lint_pass
+def shared_state_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        if not cfg.in_concurrency_scope(sf.rel):
+            continue
+        out.extend(check_shared_state(sf, cfg))
+    return out

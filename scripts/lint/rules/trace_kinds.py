@@ -100,7 +100,10 @@ def trace_kinds_pass(ctx: Context) -> List[Finding]:
                 ))
     # the stale-row direction needs the FULL emission corpus — same guard
     # as BGT031: the package __init__ in the corpus is the full-run proxy
-    full_corpus = ctx.by_suffix(cfg.package_dir + "/__init__.py") is not None
+    full_corpus = (
+        ctx.by_suffix(cfg.package_dir + "/__init__.py") is not None
+        and not getattr(cfg, "partial_corpus", False)
+    )
     if full_corpus:
         for kind in sorted(doc_kinds - emitted):
             out.append(Finding(

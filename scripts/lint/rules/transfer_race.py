@@ -1,0 +1,479 @@
+"""Staging-rewrite and donation transfer races — BGT063.
+
+The packed-upload path (docs/architecture.md "Upload staging") hands the
+device an async view of host memory: ``jax.device_put(buf)`` returns
+immediately and the DMA reads ``buf`` *later*.  Rewriting that buffer
+before the transfer lands corrupts the in-flight upload — silently, on
+device, with no host-side error — and the same hazard applies to arrays
+donated via ``jax.jit(..., donate_argnums=...)``: after the donated call,
+the caller's array aliases freed device memory.  SyncTest never catches
+either (single-stepped runs always land before the rewrite); this rule
+makes the ordering contract static, and the ``BGT_SANITIZE=1`` runtime
+sanitizer (bevy_ggrs_tpu/utils/staging.py) enforces it dynamically.
+
+Four detections, in increasing order of reach:
+
+1. **guard files** (``config.TRANSFER_GUARD_FILES``): *any* un-barriered
+   ``device_put`` — the staging funnel is exactly where every upload must
+   either block or hand ownership to a rotation protocol, so an
+   unbarriered site there is a finding by default and the protocol that
+   makes it safe must be spelled out in a ``# bgt: ignore[BGT063]``
+   reason.
+2. **reused staging attrs**: a ``self.X`` that is (a) allocated from a
+   pool factory (``np.empty``-family or ``.new_buffer``) and (b)
+   subscript-rewritten somewhere in its class is a *reused* buffer;
+   uploading it without a barrier races detection 2's rewrite sites.
+3. **interprocedural**: a function that uploads its parameter
+   un-barriered gives that parameter an "uploads async" effect; the
+   effect propagates backwards through the package call graph (same
+   resolution as BGT011), so passing a reused staging attr into a helper
+   that uploads three calls deep is flagged at the call site with the
+   full chain.
+4. **donation**: a name bound from ``jax.jit(..., donate_argnums=N)``
+   donates its N-th argument; any read of that argument after the call,
+   with no rebinding in between, touches freed device memory.
+
+A barrier is ``x.block_until_ready()`` on the bound result (or chained
+directly on the call).  A ``# bgt: ignore[BGT063]: <why>`` on the
+``device_put`` line sanctions the site for every caller — same seed-line
+contract as BGT011 — and is tracked as load-bearing for BGT005.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+from .purity import CallGraph, FuncKey
+
+rule(
+    "BGT063", "transfer-race",
+    summary="a staging buffer or donated array can be rewritten/read "
+            "before the async transfer that consumes it lands",
+)
+
+
+# -- per-function facts -------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _TFunc:
+    key: FuncKey
+    cls: Optional[str]
+    # un-barriered, un-suppressed device_put sites: (line, desc)
+    uploads: List[Tuple[int, tuple]] = dataclasses.field(default_factory=list)
+    # call sites with positional-arg descriptors: (line, ref, [desc, ...])
+    calls: List[Tuple[int, tuple, list]] = dataclasses.field(
+        default_factory=list
+    )
+    # donated-call reuse findings, pre-formatted: (line, message)
+    donation_hits: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+def _strip_subscript(node: ast.AST) -> ast.AST:
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def _desc(node: ast.AST, params: Dict[str, int]) -> tuple:
+    """What flows into an upload/call position, after peeling slices:
+    ``self.X[...]`` -> ("self_attr", X); a parameter -> ("param", i)."""
+    node = _strip_subscript(node)
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        if node.id == "self" and chain:
+            return ("self_attr", chain[-1])
+        if not chain and node.id in params:
+            return ("param", params[node.id])
+    return ("other",)
+
+
+def _is_device_put(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr == "device_put"
+    return isinstance(f, ast.Name) and f.id == "device_put"
+
+
+def _jit_donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """donate_argnums of a ``jax.jit(...)`` call, literal only."""
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    if name != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return {v.value}
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None
+                out.add(e.value)
+            return out
+    return None
+
+
+class _TransferCollector(ast.NodeVisitor):
+    """One module's upload sites, staging attrs, donation bindings and
+    call-argument flow — qualnames mirror the purity collector so the
+    shared CallGraph can resolve our refs."""
+
+    def __init__(self, sf: SourceFile, cfg, used: set):
+        self.sf = sf
+        self.cfg = cfg
+        self.used = used
+        self.funcs: Dict[str, _TFunc] = {}
+        # cls -> attrs allocated from a pool factory / subscript-rewritten
+        self.factory_attrs: Dict[str, Set[str]] = {}
+        self.written_attrs: Dict[str, Set[str]] = {}
+        # donated bindings: bare name / self attr -> donated positions
+        self.donated_names: Dict[str, Set[int]] = {}
+        self.donated_self: Dict[str, Set[int]] = {}
+        self._stack: List[str] = []
+        self._cls: List[Optional[str]] = []
+
+    def collect(self):
+        # donation bindings first — a method may call a jitted self-attr
+        # bound in __init__ further down the file
+        for node in ast.walk(self.sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            pos = _jit_donated_positions(node.value)
+            if pos is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.donated_names[t.id] = pos
+                elif (isinstance(t, ast.Attribute)
+                      and isinstance(t.value, ast.Name)
+                      and t.value.id == "self"):
+                    self.donated_self[t.attr] = pos
+        self.visit(self.sf.tree)
+        return self
+
+    def reused_staging(self, cls: Optional[str]) -> Set[str]:
+        if cls is None:
+            return set()
+        return (self.factory_attrs.get(cls, set())
+                & self.written_attrs.get(cls, set()))
+
+    # -- structure ----------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._stack.append(node.name)
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+        self._stack.pop()
+
+    def _enter_func(self, node):
+        qual = ".".join(self._stack + [node.name])
+        cls = self._cls[-1] if self._cls else None
+        fn = _TFunc(key=(self.sf.rel, qual), cls=cls)
+        self.funcs[qual] = fn
+        params = {
+            a.arg: i for i, a in enumerate(
+                [p for p in node.args.posonlyargs + node.args.args
+                 if p.arg not in ("self", "cls")]
+            )
+        }
+        self._scan_body(node, fn, params, cls)
+        self._stack.append(node.name)
+        self._cls.append(None)
+        self.generic_visit(node)
+        self._cls.pop()
+        self._stack.pop()
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _enter_func
+
+    # -- body scan ----------------------------------------------------------
+    def _scan_body(self, fnode, fn: _TFunc, params: Dict[str, int],
+                   cls: Optional[str]):
+        uploads: List[Tuple[ast.Call, int, tuple, Optional[str]]] = []
+        barriered_nodes: Set[int] = set()
+        barriered_names: Set[str] = set()
+        donated_calls: List[Tuple[int, str, str]] = []  # (line, var, fname)
+        name_loads: List[Tuple[int, str]] = []
+        name_stores: List[Tuple[int, str]] = []
+
+        def scan(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                self._scan_stmt(
+                    child, fn, params, cls, uploads, barriered_nodes,
+                    barriered_names, donated_calls,
+                )
+                if isinstance(child, ast.Name):
+                    if isinstance(child.ctx, ast.Load):
+                        name_loads.append((child.lineno, child.id))
+                    elif isinstance(child.ctx, ast.Store):
+                        name_stores.append((child.lineno, child.id))
+                scan(child)
+
+        scan(fnode)
+
+        # resolve barriers: a site survives only if neither the call node
+        # nor its bound name ever hits block_until_ready
+        for call, line, desc, bound in uploads:
+            if id(call) in barriered_nodes:
+                continue
+            if bound is not None and bound in barriered_names:
+                continue
+            if "BGT063" in self.sf.suppressions.get(line, {}):
+                # sanctioned upload: no finding, no effect — but the
+                # suppression is load-bearing (BGT005 must not flag it)
+                self.used.add((self.sf.rel, line, "BGT063"))
+                continue
+            fn.uploads.append((line, desc))
+
+        # donation reuse: a read of the donated variable after the call
+        # with no rebinding in between
+        for call_line, var, fname in donated_calls:
+            stores = sorted(l for l, n in name_stores
+                            if n == var and l >= call_line)
+            for load_line in sorted(l for l, n in name_loads
+                                    if n == var and l > call_line):
+                if any(call_line <= s <= load_line for s in stores):
+                    break  # rebound before (or at) this read: safe again
+                fn.donation_hits.append((
+                    load_line,
+                    f"donated-array reuse: {var!r} was donated to "
+                    f"{fname}(...) on line {call_line} "
+                    "(jax.jit donate_argnums) and is read here — after "
+                    "donation the array aliases freed device memory; "
+                    "rebind it from the call result or drop the donation",
+                ))
+                break  # one finding per donated call is enough
+
+    def _scan_stmt(self, node, fn: _TFunc, params, cls,
+                   uploads, barriered_nodes, barriered_names, donated_calls):
+        # staging-attr classification (anywhere in the class, incl __init__)
+        if isinstance(node, ast.Assign) and cls is not None:
+            for t in node.targets:
+                base = _strip_subscript(t)
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "self"):
+                    self.written_attrs.setdefault(cls, set()).add(base.attr)
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and isinstance(node.value, ast.Call)):
+                    vf = node.value.func
+                    fname = vf.attr if isinstance(vf, ast.Attribute) else (
+                        vf.id if isinstance(vf, ast.Name) else None
+                    )
+                    if fname is not None and (
+                        fname in self.cfg.staging_factory_names
+                        or fname in self.cfg.staging_factory_attrs
+                    ):
+                        self.factory_attrs.setdefault(cls, set()).add(t.attr)
+        if not isinstance(node, ast.Call):
+            return
+        f = node.func
+        # barrier forms
+        if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+            if isinstance(f.value, ast.Call):
+                barriered_nodes.add(id(f.value))
+            elif isinstance(f.value, ast.Name):
+                barriered_names.add(f.value.id)
+        # upload sites (bound name recovered from the enclosing assign by
+        # the caller would be cleaner, but a parent-pointer walk is enough)
+        if _is_device_put(node) and node.args:
+            desc = _desc(node.args[0], params)
+            uploads.append((node, node.lineno, desc, self._bound_name(node)))
+        # donated-function invocations
+        dpos = None
+        fname = None
+        if isinstance(f, ast.Name) and f.id in self.donated_names:
+            dpos, fname = self.donated_names[f.id], f.id
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name) and f.value.id == "self"
+              and f.attr in self.donated_self):
+            dpos, fname = self.donated_self[f.attr], f"self.{f.attr}"
+        if dpos is not None:
+            for p in sorted(dpos):
+                if p < len(node.args):
+                    arg = _strip_subscript(node.args[p])
+                    if isinstance(arg, ast.Name):
+                        donated_calls.append((node.lineno, arg.id, fname))
+        # call-argument flow for the interprocedural half (same ref shapes
+        # as the purity collector, so CallGraph.resolve understands them)
+        descs = [_desc(a, params) for a in node.args]
+        if isinstance(f, ast.Name):
+            fn.calls.append((node.lineno, ("bare", f.id), descs))
+        elif isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self":
+                    fn.calls.append((node.lineno, ("self", f.attr), descs))
+                else:
+                    fn.calls.append(
+                        (node.lineno, ("name_attr", recv.id, f.attr), descs)
+                    )
+            else:
+                fn.calls.append((node.lineno, ("obj_attr", f.attr), descs))
+
+    def _bound_name(self, call: ast.Call) -> Optional[str]:
+        # `x = jax.device_put(...)` — found by locating the assign whose
+        # value subtree contains the call, so conditional shapes like
+        # `x = put(a, s) if s else put(a)` still bind (the tree is small,
+        # so a parent scan per upload site is fine)
+        for node in ast.walk(self.sf.tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and any(n is call for n in ast.walk(node.value))):
+                return node.targets[0].id
+        return None
+
+
+# -- interprocedural effect propagation --------------------------------------
+
+
+class _TransferGraph:
+    """Backward propagation of the "uploads param i un-barriered" effect
+    over the purity call graph's resolution machinery."""
+
+    def __init__(self, ctx: Context):
+        cfg = ctx.config
+        self.cfg = cfg
+        self.graph = CallGraph(ctx)
+        self.collectors: Dict[str, _TransferCollector] = {}
+        self.tfuncs: Dict[FuncKey, _TFunc] = {}
+        pkg = cfg.package_dir
+        for sf in ctx.files:
+            in_pkg = sf.rel == pkg or sf.rel.startswith(pkg + "/")
+            if not in_pkg or sf.tree is None:
+                continue
+            col = _TransferCollector(sf, cfg, ctx.used_suppressions).collect()
+            self.collectors[sf.rel] = col
+            for fn in col.funcs.values():
+                self.tfuncs[fn.key] = fn
+        # effects[key] = {param index -> why}; why is
+        # ("direct", line) | ("via", line, callee_key, callee_param)
+        self.effects: Dict[FuncKey, Dict[int, tuple]] = {}
+        for key, fn in self.tfuncs.items():
+            for line, desc in fn.uploads:
+                if desc[0] == "param":
+                    self.effects.setdefault(key, {}) \
+                        .setdefault(desc[1], ("direct", line))
+        self._resolved: Dict[FuncKey, List[Tuple[int, FuncKey, list]]] = {}
+        for key, fn in self.tfuncs.items():
+            mod = self.graph.by_rel.get(key[0])
+            caller = self.graph.funcs.get(key)
+            if mod is None or caller is None:
+                continue
+            res = []
+            for line, ref, descs in fn.calls:
+                tgt = self.graph.resolve(mod, caller, ref)
+                if tgt is not None and tgt.key != key:
+                    res.append((line, tgt.key, descs))
+            self._resolved[key] = res
+        self._propagate()
+
+    def _propagate(self):
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.tfuncs.items():
+                for line, tkey, descs in self._resolved.get(key, []):
+                    teffects = self.effects.get(tkey)
+                    if not teffects:
+                        continue
+                    for j, desc in enumerate(descs):
+                        if j not in teffects or desc[0] != "param":
+                            continue
+                        mine = self.effects.setdefault(key, {})
+                        if desc[1] not in mine:
+                            mine[desc[1]] = ("via", line, tkey, j)
+                            changed = True
+
+    def chain(self, key: FuncKey, param: int) -> str:
+        hops = []
+        for _ in range(32):
+            why = self.effects.get(key, {}).get(param)
+            if why is None:
+                break
+            if why[0] == "direct":
+                hops.append(
+                    f"{key[1]}() uploads its arg un-barriered "
+                    f"({key[0]}:{why[1]})"
+                )
+                break
+            _, line, key2, param2 = why
+            hops.append(f"{key[1]}() [{key[0]}:{line}]")
+            key, param = key2, param2
+        return " -> ".join(hops)
+
+
+# -- pass ---------------------------------------------------------------------
+
+
+@lint_pass
+def transfer_race_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    tg = _TransferGraph(ctx)
+    out: List[Finding] = []
+    for rel, col in sorted(tg.collectors.items()):
+        guard = cfg.is_transfer_guard_file(rel)
+        for qual, fn in sorted(col.funcs.items()):
+            staging = col.reused_staging(fn.cls)
+            # direct un-barriered uploads
+            for line, desc in fn.uploads:
+                if guard:
+                    out.append(Finding(
+                        "BGT063", rel, line,
+                        f"transfer race: {qual}() calls device_put without "
+                        "a barrier in a staging funnel — the DMA reads the "
+                        "host buffer later; block_until_ready the result, "
+                        "or document the rotation protocol that delays the "
+                        "rewrite in a suppression reason",
+                    ))
+                elif desc[0] == "self_attr" and desc[1] in staging:
+                    out.append(Finding(
+                        "BGT063", rel, line,
+                        f"transfer race: {qual}() uploads the reused "
+                        f"staging buffer self.{desc[1]} without a barrier "
+                        "— this class subscript-rewrites that buffer, and "
+                        "an un-landed upload still reads it; barrier the "
+                        "result or rotate through a StagingQueue",
+                    ))
+            # interprocedural: reused staging attr flowing into an
+            # uploading callee's effect position
+            for line, tkey, descs in tg._resolved.get(fn.key, []):
+                teffects = tg.effects.get(tkey, {})
+                for j, desc in enumerate(descs):
+                    if j not in teffects:
+                        continue
+                    if desc[0] == "self_attr" and desc[1] in staging:
+                        out.append(Finding(
+                            "BGT063", rel, line,
+                            f"transfer race: {qual}() passes the reused "
+                            f"staging buffer self.{desc[1]} into an "
+                            "un-barriered upload path: "
+                            f"{tg.chain(tkey, j)} — the buffer can be "
+                            "rewritten before the DMA lands",
+                        ))
+            # donation reuse
+            for line, msg in fn.donation_hits:
+                out.append(Finding("BGT063", rel, line, msg))
+    return out

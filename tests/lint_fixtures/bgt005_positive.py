@@ -1,0 +1,7 @@
+"""BGT005 positive: the ignore below names a rule (BGT042) that never
+fires on the line it covers — a rotted suppression."""
+
+
+def total(values):
+    # bgt: ignore[BGT042]: stale — the set-iteration sum was refactored away
+    return sum(sorted(values))

@@ -1,0 +1,9 @@
+"""BGT005 suppressed: the stale BGT042 ignore is itself waived with a
+justified BGT005 suppression on the same origin line (a deliberate
+keep-for-now, e.g. mid-refactor)."""
+
+
+def total(values):
+    # bgt: ignore[BGT042, BGT005]: kept during the sort refactor — the set
+    # path returns next PR and the justification should survive with it
+    return sum(sorted(values))

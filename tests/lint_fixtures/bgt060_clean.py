@@ -1,0 +1,19 @@
+"""BGT060 clean: every cross-thread write of ``_series`` holds the SAME
+lock (``self._lock``) — the textual common-lock witness."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._series = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._scrape, daemon=True)
+
+    def _scrape(self):
+        with self._lock:
+            self._series["scrape"] = 1
+
+    def tick(self):
+        with self._lock:
+            self._series["tick"] = 2

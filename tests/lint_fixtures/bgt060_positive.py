@@ -1,0 +1,18 @@
+"""BGT060 positive: ``_series`` is written from the scrape thread
+(``Thread(target=self._scrape)``) AND the foreground tick loop with no
+common lock — the lock exists but neither writer holds it."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._series = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._scrape, daemon=True)
+
+    def _scrape(self):
+        self._series["scrape"] = 1
+
+    def tick(self):
+        self._series["tick"] = 2

@@ -1,0 +1,18 @@
+"""BGT060 suppressed: same unlocked cross-thread write, waived with a
+(fixture) protocol justification at the reporting write site."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._series = {}
+        self._thread = threading.Thread(target=self._scrape, daemon=True)
+
+    def _scrape(self):
+        # bgt: ignore[BGT060]: fixture — single-writer epoch protocol, the
+        # tick loop only writes before start() (pretend)
+        self._series["scrape"] = 1
+
+    def tick(self):
+        self._series["tick"] = 2

@@ -1,0 +1,18 @@
+"""BGT061 clean: copy state under the lock, release it, THEN block."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._thread = threading.Thread(target=self.poll, daemon=True)
+
+    def poll(self):
+        with self._lock:
+            drained = list(self._pending)
+            self._pending.clear()
+        time.sleep(0.01)
+        return drained

@@ -1,0 +1,20 @@
+"""BGT061 positive: socket recv and a sleep, both while the lock is held
+— every thread sharing ``self._lock`` stalls for the full wait."""
+
+import socket
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._thread = threading.Thread(target=self.poll, daemon=True)
+
+    def poll(self):
+        with self._lock:
+            data, addr = self._sock.recvfrom(65536)
+            time.sleep(0.01)
+            self._pending.append((data, addr))

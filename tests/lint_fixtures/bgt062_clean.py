@@ -1,0 +1,20 @@
+"""BGT062 clean: both paths acquire in the one canonical order."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self._thread = threading.Thread(target=self.debit, daemon=True)
+
+    def credit(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def debit(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass

@@ -1,0 +1,25 @@
+"""BGT062 positive: ``credit`` nests a_lock -> b_lock, ``debit`` nests
+b_lock -> a_lock — the classic ABBA deadlock, witnessed at both sites."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self.a = 0
+        self.b = 0
+        self._thread = threading.Thread(target=self.debit, daemon=True)
+
+    def credit(self):
+        with self.a_lock:
+            with self.b_lock:
+                self.a += 1
+                self.b -= 1
+
+    def debit(self):
+        with self.b_lock:
+            with self.a_lock:
+                self.b += 1
+                self.a -= 1

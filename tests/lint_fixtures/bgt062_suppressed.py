@@ -1,0 +1,23 @@
+"""BGT062 suppressed: the ABBA pair waived with a (fixture) argument that
+the two paths can never run concurrently."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self._thread = threading.Thread(target=self.debit, daemon=True)
+
+    def credit(self):
+        with self.a_lock:
+            # bgt: ignore[BGT062]: fixture — credit only runs before the
+            # debit thread starts (single-phase handoff, pretend)
+            with self.b_lock:
+                pass
+
+    def debit(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass

@@ -1,0 +1,27 @@
+"""BGT063 clean: the upload barriers its bound result before returning,
+and the donated name is rebound from the call result before any read."""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda w: w + 1, donate_argnums=0)
+
+
+class Stager:
+    def __init__(self):
+        self.buf = np.zeros((8, 4), dtype=np.float32)
+
+    def pack(self, rows):
+        for i, r in enumerate(rows):
+            self.buf[i] = r
+
+    def upload(self):
+        x = jax.device_put(self.buf)
+        x.block_until_ready()
+        return x
+
+
+def advance(world):
+    out = step(world)
+    world = out
+    return out + world

@@ -1,0 +1,24 @@
+"""BGT063 positive: one staging race (reused ``self.buf`` uploaded with
+no barrier) and one donation race (``world`` read after being donated)."""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda w: w + 1, donate_argnums=0)
+
+
+class Stager:
+    def __init__(self):
+        self.buf = np.zeros((8, 4), dtype=np.float32)
+
+    def pack(self, rows):
+        for i, r in enumerate(rows):
+            self.buf[i] = r
+
+    def upload(self):
+        return jax.device_put(self.buf)
+
+
+def advance(world):
+    out = step(world)
+    return out + world

@@ -1,0 +1,29 @@
+"""BGT063 suppressed: the staging upload carries a seed-line protocol
+sanction (kills the finding AND the effect, tracked as load-bearing);
+the donation reuse is waived at the read site."""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda w: w + 1, donate_argnums=0)
+
+
+class Stager:
+    def __init__(self):
+        self.buf = np.zeros((8, 4), dtype=np.float32)
+
+    def pack(self, rows):
+        for i, r in enumerate(rows):
+            self.buf[i] = r
+
+    def upload(self):
+        # bgt: ignore[BGT063]: fixture — rotation protocol, pack() only
+        # rewrites this buffer after the caller's fence (pretend)
+        return jax.device_put(self.buf)
+
+
+def advance(world):
+    out = step(world)
+    # bgt: ignore[BGT063]: fixture — `world` is a host-side copy here, the
+    # donated device buffer is not aliased (pretend)
+    return out + world

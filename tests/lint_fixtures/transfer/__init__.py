@@ -1,0 +1,2 @@
+"""BGT063 interprocedural positive: driver passes a reused staging
+buffer into a helper that uploads it un-barriered."""

@@ -1,0 +1,2 @@
+"""BGT063 interprocedural clean: the helper barriers its upload, so no
+effect propagates to the driver."""

@@ -1,0 +1,7 @@
+import jax
+
+
+def upload_rows(rows):
+    x = jax.device_put(rows)
+    x.block_until_ready()
+    return x

@@ -1,0 +1,2 @@
+"""BGT063 interprocedural suppressed: the helper's seed-line sanction
+kills the effect, so the driver's call site is clean too."""

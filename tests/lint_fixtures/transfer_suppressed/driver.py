@@ -1,0 +1,18 @@
+import numpy as np
+
+from .helper import upload_rows
+
+
+class PackedStager:
+    def __init__(self):
+        self.buf = np.zeros((16, 8), dtype=np.float32)
+
+    def pack(self, rows):
+        k = 0
+        for r in rows:
+            self.buf[k] = r
+            k += 1
+        return k
+
+    def flush(self, k):
+        return upload_rows(self.buf[:k])

@@ -1,0 +1,7 @@
+import jax
+
+
+def upload_rows(rows):
+    # bgt: ignore[BGT063]: fixture — every caller fences before the next
+    # rewrite (pretend rotation protocol), sanctioned for all callers
+    return jax.device_put(rows)

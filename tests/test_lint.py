@@ -75,6 +75,7 @@ TRIPLES = [
     ("BGT040", "models/bgt040", 3),
     ("BGT043", "models/bgt043", 3),
     ("BGT044", "models/bgt044", 3),
+    ("BGT005", "bgt005", 1),
 ]
 
 
@@ -444,6 +445,182 @@ def test_rule_docs_catalog_matches_registry_exactly():
     the human-readable half of the BGT050/BGT051 gate."""
     ids = docs_rule_ids((ROOT / "docs/static-analysis.md").read_text())
     assert ids == set(RULES)
+
+
+# -- concurrency & transfer races (BGT06x) ------------------------------------
+
+# same triple contract as TRIPLES, but each rule needs its fixture files
+# pulled into the analyzer's scope (concurrency_modules / package_dir)
+CONCUR_TRIPLES = [
+    ("BGT060", "bgt060", 1),
+    ("BGT061", "bgt061", 2),
+    ("BGT062", "bgt062", 1),
+    ("BGT063", "bgt063", 2),
+]
+
+
+def _concur_cfg(stem):
+    if stem == "bgt063":
+        return dict(package_dir="tests/lint_fixtures")
+    return dict(concurrency_modules=(
+        f"{stem}_positive.py", f"{stem}_suppressed.py", f"{stem}_clean.py",
+    ))
+
+
+@pytest.mark.parametrize("rule_id,stem,n_pos", CONCUR_TRIPLES,
+                         ids=[t[0] for t in CONCUR_TRIPLES])
+def test_concurrency_fixture_positive_fires(rule_id, stem, n_pos):
+    hits = only(lint_paths([FIXTURES / f"{stem}_positive.py"],
+                           **_concur_cfg(stem)), rule_id)
+    assert len(hits) == n_pos, [f.as_dict() for f in hits]
+    assert all(not f.suppressed for f in hits)
+    assert all(f.severity == "error" for f in hits)
+
+
+@pytest.mark.parametrize("rule_id,stem,n_pos", CONCUR_TRIPLES,
+                         ids=[t[0] for t in CONCUR_TRIPLES])
+def test_concurrency_fixture_suppression_respected(rule_id, stem, n_pos):
+    hits = only(lint_paths([FIXTURES / f"{stem}_suppressed.py"],
+                           **_concur_cfg(stem)), rule_id)
+    assert hits, "the suppressed fixture must still trip the rule"
+    assert all(f.suppressed for f in hits)
+    assert all(f.suppress_reason for f in hits)
+
+
+@pytest.mark.parametrize("rule_id,stem,n_pos", CONCUR_TRIPLES,
+                         ids=[t[0] for t in CONCUR_TRIPLES])
+def test_concurrency_fixture_clean_is_clean(rule_id, stem, n_pos):
+    assert only(lint_paths([FIXTURES / f"{stem}_clean.py"],
+                           **_concur_cfg(stem)), rule_id) == []
+
+
+def test_bgt060_declared_thread_roots_engage_the_analysis():
+    """No ``Thread(...)`` in the module: the analysis is vacuous until the
+    entry point is declared in config.THREAD_ROOTS (the telemetry
+    registry's situation — its scrape thread lives in scripts/)."""
+    path = FIXTURES / "bgt060_roots.py"
+    scope = dict(concurrency_modules=("bgt060_roots.py",))
+    assert only(lint_paths([path], **scope), "BGT060") == []
+    hits = only(lint_paths(
+        [path], thread_roots={"bgt060_roots.py": {"Series.bump"}}, **scope,
+    ), "BGT060")
+    assert len(hits) == 1, [f.as_dict() for f in hits]
+    assert "_vals" in hits[0].message
+
+
+def test_bgt060_real_registry_locking_is_load_bearing(tmp_path):
+    """Strip the metrics registry's ``with self._reg._lock:`` blocks and
+    BGT060 must fire — proof the rule watches the real control plane and
+    the repo's locking is what keeps HEAD clean."""
+    src = (ROOT / "bevy_ggrs_tpu/telemetry/metrics.py").read_text()
+    assert "with self._reg._lock:" in src
+    stripped = src.replace("with self._reg._lock:", "if True:")
+    mod = tmp_path / "metrics_unlocked.py"
+    mod.write_text(stripped)
+    from scripts.lint.config import THREAD_ROOTS
+    hits = only(lint_paths(
+        [mod],
+        concurrency_modules=("metrics_unlocked.py",),
+        thread_roots={
+            "metrics_unlocked.py":
+                THREAD_ROOTS["bevy_ggrs_tpu/telemetry/metrics.py"],
+        },
+    ), "BGT060")
+    assert hits, "unlocked cross-thread registry writes must be flagged"
+    assert only(lint_paths(
+        [ROOT / "bevy_ggrs_tpu/telemetry/metrics.py"]), "BGT060") == []
+
+
+def _transfer_paths(pkg):
+    d = FIXTURES / pkg
+    return [d / "__init__.py", d / "driver.py", d / "helper.py"]
+
+
+def test_bgt063_interprocedural_chain_flagged():
+    """The reused staging buffer flows into a helper that uploads it
+    un-barriered one call away — flagged at the driver's call site with
+    the chain down to the direct device_put."""
+    findings = lint_paths(_transfer_paths("transfer"),
+                          package_dir="tests/lint_fixtures/transfer")
+    hits = only(findings, "BGT063")
+    assert len(hits) == 1, [f.as_dict() for f in findings]
+    f = hits[0]
+    assert f.path.endswith("transfer/driver.py") and not f.suppressed
+    for fragment in ("flush", "self.buf", "upload_rows", "un-barriered",
+                     "helper.py"):
+        assert fragment in f.message, f.message
+
+
+def test_bgt063_seed_suppression_sanctions_every_caller():
+    findings = lint_paths(
+        _transfer_paths("transfer_suppressed"),
+        package_dir="tests/lint_fixtures/transfer_suppressed",
+    )
+    assert only(findings, "BGT063") == [], \
+        "suppressing at the seed (upload) line must clear the chain"
+    # ...and the seed comment is load-bearing, not stale (BGT005)
+    assert only(findings, "BGT005") == []
+
+
+def test_bgt063_clean_chain_is_clean():
+    findings = lint_paths(_transfer_paths("transfer_clean"),
+                          package_dir="tests/lint_fixtures/transfer_clean")
+    assert only(findings, "BGT063") == []
+
+
+# -- incremental (--changed) slice --------------------------------------------
+
+
+def test_expand_dependents_pulls_in_reverse_importers():
+    from scripts.lint.incremental import expand_dependents
+
+    out = expand_dependents({"bevy_ggrs_tpu/fleet/protocol.py"}, ROOT)
+    assert "bevy_ggrs_tpu/fleet/protocol.py" in out
+    # worker and scheduler import the protocol module; linting them is what
+    # keeps cross-file rules honest on the slice
+    assert "bevy_ggrs_tpu/fleet/worker.py" in out
+    assert "bevy_ggrs_tpu/fleet/scheduler.py" in out
+
+
+def test_expand_dependents_ignores_non_corpus_files():
+    from scripts.lint.incremental import expand_dependents
+
+    assert expand_dependents(
+        {"docs/observability.md", "no/such/file.py"}, ROOT) == []
+
+
+def test_changed_slice_agrees_with_full_run():
+    """On the files it lints, a --changed slice must report exactly the
+    full run's findings, minus the whole-repo reverse checks the partial
+    corpus structurally cannot support."""
+    from scripts.lint.incremental import expand_dependents
+
+    PARTIAL_SKIPPED = {"BGT005", "BGT022", "BGT031", "BGT033"}
+    slice_paths = expand_dependents(
+        {"bevy_ggrs_tpu/fleet/protocol.py"}, ROOT)
+    assert slice_paths
+
+    def key(fs, paths):
+        return sorted(
+            (f.rule, f.path, f.line, f.suppressed)
+            for f in fs
+            if f.path in paths and f.rule not in PARTIAL_SKIPPED
+        )
+
+    sliced, _ = run(slice_paths, root=ROOT,
+                    config=Config(partial_corpus=True))
+    full, _ = run(None, root=ROOT, config=Config())
+    in_slice = set(slice_paths)
+    assert key(sliced, in_slice) == key(full, in_slice)
+
+
+def test_changed_cli_exits_zero():
+    res = subprocess.run(
+        [sys.executable, "-m", "scripts.lint", "--changed"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "--changed" in res.stdout
 
 
 # -- suppression parsing ------------------------------------------------------

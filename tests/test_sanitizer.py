@@ -1,0 +1,159 @@
+"""BGT_SANITIZE transfer-race sanitizer: the seeded staging-reuse race is
+caught with the sanitizer armed and (silently) missed without it, the
+legitimate protocols (sync commit, StagingQueue rotation, recycle
+rebinding) stay quiet, and violations are counted per rule.
+
+The race seed mirrors the exact hazard the module docstring describes:
+``StagingQueue.commit`` starts an async upload and does NOT block — a
+rewrite of the same backing buffer before the matching ``acquire()`` is
+the corruption BGT063 exists for.  The sanitizer's ledger is stamp-based
+(commit stamps, acquire clears), so the test is deterministic even on a
+CPU backend where the transfer itself lands instantly.
+"""
+
+import numpy as np
+import pytest
+
+from bevy_ggrs_tpu import telemetry
+from bevy_ggrs_tpu.ops.packing import pack_prefix
+from bevy_ggrs_tpu.utils import staging
+from bevy_ggrs_tpu.utils.staging import (
+    StagingQueue,
+    TransferRaceError,
+    TransferSanitizer,
+)
+
+
+@pytest.fixture(autouse=True)
+def _sanitizer_off_after():
+    yield
+    staging.set_sanitize(False)
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _mk():
+    return np.zeros((4, 32), dtype=np.int8)
+
+
+def test_seeded_staging_reuse_race_caught_only_when_armed():
+    # armed: rewriting the committed buffer before its acquire() raises
+    staging.set_sanitize(True)
+    q = StagingQueue(_mk, depth=2)
+    buf = q.acquire()
+    pack_prefix(buf, 0, 3)
+    q.commit(buf[:3])
+    with pytest.raises(TransferRaceError, match="in flight"):
+        pack_prefix(buf, 1, 3)
+
+    # disarmed (the default): the same seeded race passes silently
+    staging.set_sanitize(False)
+    q2 = StagingQueue(_mk, depth=2)
+    b2 = q2.acquire()
+    pack_prefix(b2, 0, 3)
+    q2.commit(b2[:3])
+    pack_prefix(b2, 1, 3)  # no raise: this is the silent corruption
+
+
+def test_rotation_protocol_never_trips_the_sanitizer():
+    staging.set_sanitize(True)
+    q = StagingQueue(_mk, depth=2)
+    for tick in range(8):
+        buf = q.acquire()
+        pack_prefix(buf, tick, 2)
+        q.commit(buf[:3])
+
+
+def test_sync_commit_allows_immediate_rewrite():
+    staging.set_sanitize(True)
+    buf = _mk()
+    x = staging.commit(buf)
+    assert np.array_equal(np.asarray(x), buf)
+    pack_prefix(buf, 5, 1)  # commit() landed the transfer: no raise
+
+
+def test_donation_guard_and_rebind():
+    san = staging.set_sanitize(True)
+    a, b = _mk(), _mk()
+    san.guard_donated(a, "test")  # never donated: fine
+    san.donate(a, "wave 0")
+    with pytest.raises(TransferRaceError, match="donated"):
+        san.guard_donated(a, "test")
+    san.undonate(a)  # slot rebound from the call result
+    san.guard_donated(a, "test")
+    san.guard_donated(b, "test")
+
+
+def test_donated_table_is_bounded():
+    san = staging.set_sanitize(True)
+    arrs = [np.zeros(1, np.int8) for _ in range(TransferSanitizer._DONATED_CAP + 8)]
+    for i, a in enumerate(arrs):
+        san.donate(a, f"wave {i}")
+    assert len(san._donated) == TransferSanitizer._DONATED_CAP
+    san.guard_donated(arrs[0], "test")  # oldest entries aged out
+    with pytest.raises(TransferRaceError):
+        san.guard_donated(arrs[-1], "test")
+
+
+def test_violations_counted_per_rule():
+    telemetry.enable()
+    san = staging.set_sanitize(True)
+    buf = _mk()
+    san.begin(buf, "test upload")
+    with pytest.raises(TransferRaceError):
+        san.guard_write(buf, "test rewrite")
+    san.donate(buf)
+    with pytest.raises(TransferRaceError):
+        san.guard_donated(buf, "test redispatch")
+    assert san.violations == 2
+    c = telemetry.registry().counter("sanitizer_violations_total", "")
+    assert c.value(rule="staging_reuse") == 1
+    assert c.value(rule="donated_reuse") == 1
+
+
+def test_env_var_arms_the_default_sanitizer(monkeypatch):
+    monkeypatch.setenv("BGT_SANITIZE", "1")
+    assert TransferSanitizer().enabled
+    monkeypatch.delenv("BGT_SANITIZE")
+    assert not TransferSanitizer().enabled
+
+
+def test_disabled_hooks_are_noops():
+    san = TransferSanitizer(enabled=False)
+    buf = _mk()
+    san.begin(buf)
+    san.guard_write(buf)
+    san.donate(buf)
+    san.guard_donated(buf)
+    san.undonate(buf)
+    assert san.violations == 0 and san._inflight == {} and san._donated == {}
+
+
+def test_executor_recycle_donation_guard():
+    """The batched executor's recycle path must (a) run clean under the
+    sanitizer — every donated handle is rebound from the dispatch result —
+    and (b) raise if a stale donated handle is re-dispatched."""
+    from bevy_ggrs_tpu.models import stress
+    from bevy_ggrs_tpu.ops.batch import BucketedWaveExecutor, stack_worlds
+
+    staging.set_sanitize(True)
+    M, K = 2, 4
+    app = stress.make_app(32, capacity=32)
+    ex = BucketedWaveExecutor(app, K, recycle_outputs=True)
+    worlds = stack_worlds([app.init_state() for _ in range(M)])
+    inputs = np.zeros((M, K, 2), np.uint8)
+    status = np.zeros((M, K, 2), np.int8)
+    starts = np.zeros((M,), np.int32)
+
+    for _ in range(3):  # steady recycle: guard_donated then rebind, clean
+        _b, finals, _stacked, _c = ex.run_wave(
+            worlds, inputs, status, starts, [K] * M)
+        worlds = finals
+
+    key = ("exact_recycle", K)
+    assert key in ex._prev_out
+    stale = ex._prev_out[key]
+    _b, worlds, _s, _c = ex.run_wave(worlds, inputs, status, starts, [K] * M)
+    ex._prev_out[key] = stale  # reinsert handles the last wave donated
+    with pytest.raises(TransferRaceError, match="donated"):
+        ex.run_wave(worlds, inputs, status, starts, [K] * M)
