@@ -1650,7 +1650,21 @@ def stage_fleet():
     3. >= 1 failover resumed from the last CONFIRMED shipped frame after
        the SIGKILL (``outcome=failover``, resume frame > 0);
     4. admission control is wire-visible — a SUBMIT into a full fleet
-       comes back as a REJECT datagram with reason ``capacity``.
+       comes back as a REJECT datagram with reason ``capacity``;
+    5. SLO burn semantics under an induced stall — SIGSTOPping a worker
+       fires EXACTLY ONE deduplicated ``heartbeat_liveness`` alert
+       (``fleet_alert_latency_ms`` = stall to fire; bench-history floor
+       metric), which resolves after SIGCONT;
+    6. the federated HTTP surface serves under load — ``/fleet``
+       (``fleet/v1`` with non-empty series + the active alert), ``/qos``
+       (``fleet-qos/v1``) and ``/metrics`` with ``worker=`` labels;
+    7. observer ingest stays amortized-free — one heartbeat fold +
+       evaluation costs < 1% of the heartbeat cadence;
+    8. the 3-participant merged trace (in-process scheduler + both
+       workers' ``--trace-out`` dumps, one of them SIGKILLed) passes
+       ``validate_chrome_trace`` and carries ``fleet_wire`` instants, a
+       ``fleet_alert`` instant, and a cross-pid ``migration`` flow arrow
+       whose span matches the measured downtime.
 
     ``BGT_BENCH_SMOKE=1`` shrinks frames/entities; every gate stays
     armed."""
@@ -1662,12 +1676,18 @@ def stage_fleet():
 
     apply_platform_env()
     jax = _stage_setup()
+    import shutil
+    import signal
+    import tempfile
     import threading
+    import urllib.request
 
     from bevy_ggrs_tpu import telemetry
     from bevy_ggrs_tpu.fleet import (
         FleetClient, FleetScheduler, LobbySim, LobbySpec, checksum_hex,
+        FleetObserver, start_fleet_exporter,
     )
+    from bevy_ggrs_tpu.fleet.worker import HEARTBEAT_S
 
     smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
     target = 300 if smoke else FLEET_TARGET
@@ -1676,9 +1696,13 @@ def stage_fleet():
 
     telemetry.enable()
     # generous timeout: even with interleaved heartbeats, one first-step
-    # canonical compile on a loaded CI host can stall a worker for seconds
+    # canonical compile on a loaded CI host can stall a worker for seconds.
+    # The liveness SLO (1.5 s gap) pages far below this, so the SIGSTOP
+    # phase fires an alert without ever tripping a spurious failover.
     sched = FleetScheduler(worker_timeout_s=8.0)
     port = sched.local_addr[1]
+    exporter = start_fleet_exporter(sched.observer, port=0)
+    trace_dir = tempfile.mkdtemp(prefix="bgt_fleet_trace_")
     procs = {}
 
     def spawn(wid):
@@ -1691,7 +1715,9 @@ def stage_fleet():
             [sys.executable, os.path.join(ROOT, "scripts", "fleet_worker.py"),
              "--scheduler", f"127.0.0.1:{port}", "--worker-id", wid,
              "--capacity", str(FLEET_CAPACITY), "--ckpt-every", "40",
-             "--pace-fps", "240"],
+             "--pace-fps", "240",
+             "--trace-out", os.path.join(trace_dir, f"{wid}.trace.json"),
+             "--trace-every", "0.5"],
             cwd=ROOT, env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         )
@@ -1798,6 +1824,73 @@ def stage_fleet():
                 f"events: {[e['event'] for e in sched.events]}"
             )
 
+        # SLO burn: SIGSTOP the migration SOURCE (it no longer hosts the
+        # long lobby, so it is disjoint from the failover victim below)
+        # and require exactly one deduplicated heartbeat_liveness fire,
+        # then a resolve after SIGCONT.  The scrapes below run while the
+        # alert is active so /fleet provably serves under load.
+        stopped = src
+        t_stop = time.monotonic()
+        os.kill(procs[stopped].pid, signal.SIGSTOP)
+
+        def _liveness_fires():
+            return [a for a in sched.observer.alert_history()
+                    if a["slo_id"] == "heartbeat_liveness"
+                    and a["subject"] == stopped
+                    and a["state"] == "fire" and a["t"] >= t_stop]
+
+        pump_until(lambda: bool(_liveness_fires()), wait_s,
+                   f"liveness SLO alert on stalled worker {stopped}")
+        fleet_alert_latency_ms = (
+            (_liveness_fires()[0]["t"] - t_stop) * 1000.0)
+
+        base_url = f"http://127.0.0.1:{exporter.port}"
+        with urllib.request.urlopen(base_url + "/fleet", timeout=10) as r:
+            fleet_json = json.load(r)
+        with urllib.request.urlopen(base_url + "/qos", timeout=10) as r:
+            qos_json = json.load(r)
+        with urllib.request.urlopen(base_url + "/metrics", timeout=10) as r:
+            metrics_text = r.read().decode("utf-8")
+        if fleet_json.get("schema") != "fleet/v1":
+            raise RuntimeError(
+                f"fleet gate: /fleet schema {fleet_json.get('schema')!r} "
+                "(required: 'fleet/v1')")
+        for wid in ("wA", "wB"):
+            series = (fleet_json.get("workers", {}).get(wid) or {}
+                      ).get("series") or {}
+            if not series.get("qos_floor"):
+                raise RuntimeError(
+                    f"fleet gate: /fleet carries no qos_floor series for "
+                    f"{wid} (workers: {sorted(fleet_json.get('workers', {}))})"
+                )
+        if not any(a["slo_id"] == "heartbeat_liveness"
+                   and a["subject"] == stopped
+                   for a in fleet_json.get("alerts", {}).get("active", [])):
+            raise RuntimeError(
+                "fleet gate: the firing liveness alert is missing from "
+                f"/fleet active alerts: {fleet_json.get('alerts')}")
+        if qos_json.get("schema") != "fleet-qos/v1":
+            raise RuntimeError(
+                f"fleet gate: /qos schema {qos_json.get('schema')!r} "
+                "(required: 'fleet-qos/v1')")
+        if 'worker="wA"' not in metrics_text:
+            raise RuntimeError(
+                "fleet gate: federated /metrics lacks worker=\"wA\" "
+                "labeled series")
+
+        os.kill(procs[stopped].pid, signal.SIGCONT)
+        pump_until(
+            lambda: not any(a["slo_id"] == "heartbeat_liveness"
+                            and a["subject"] == stopped
+                            for a in sched.observer.active_alerts()),
+            wait_s, f"liveness alert on {stopped} to resolve")
+        fires = _liveness_fires()
+        if len(fires) != 1:
+            raise RuntimeError(
+                "fleet gate: SLO dedup broken — expected exactly one "
+                f"liveness fire for {stopped} across the stall, got "
+                f"{len(fires)}")
+
         # failover: SIGKILL the worker hosting the long lobby once a
         # confirmed checkpoint for it is in scheduler hands and the game
         # is provably still in progress
@@ -1835,6 +1928,87 @@ def stage_fleet():
                 f"confirmed-checkpoint path was not used: {bad}"
             )
 
+        # 3-participant merged trace: capture BEFORE the control resims
+        # below so the scheduler-process trace holds no tick frames of its
+        # own (workers align to it via fleet_wire send/completion pairs).
+        # The victim's file is its last periodic dump — the SIGSTOP phase
+        # between the migration and the kill guarantees it spans RESUME_OK.
+        sched_trace = telemetry.chrome_trace(process_name="scheduler")
+        worker_traces = []
+        for wid in ("wA", "wB"):
+            with open(os.path.join(trace_dir, f"{wid}.trace.json")) as f:
+                worker_traces.append(json.load(f))
+        merged = telemetry.merge_traces(sched_trace, *worker_traces)
+        errs = telemetry.validate_chrome_trace(merged)
+        if errs:
+            raise RuntimeError(
+                f"fleet gate: merged 3-way trace invalid: {errs[:5]}")
+        evs = merged["traceEvents"]
+        wire_instants = [e for e in evs if e.get("ph") == "i"
+                         and e.get("name") == "fleet_wire"]
+        if not wire_instants:
+            raise RuntimeError(
+                "fleet gate: merged trace carries no fleet_wire instants")
+        if not any(e.get("ph") == "i" and e.get("name") == "fleet_alert"
+                   for e in evs):
+            raise RuntimeError(
+                "fleet gate: merged trace carries no fleet_alert instant "
+                "(the liveness fire/resolve must land on the scheduler "
+                "track)")
+        mig_events = [e for e in sched.events if e["event"] == "migrate_ok"]
+        downtime = mig_events[-1]["downtime_ms"] if mig_events else None
+        flow_starts = {e["id"]: e for e in evs
+                       if e.get("cat") == "fleet_flow"
+                       and e.get("name") == "migration" and e["ph"] == "s"}
+        span_ms = [
+            (e["ts"] - flow_starts[e["id"]]["ts"]) / 1000.0
+            for e in evs
+            if e.get("cat") == "fleet_flow" and e.get("name") == "migration"
+            and e["ph"] == "f" and e["id"] in flow_starts
+            and e["pid"] != flow_starts[e["id"]]["pid"]
+        ]
+        if not span_ms:
+            raise RuntimeError(
+                "fleet gate: no cross-pid CKPT->RESUME_OK migration flow "
+                "arrow in the merged trace")
+        # the arrow must SPAN the measured downtime: same two endpoints,
+        # so agreement is bounded by wire-pair clock-alignment error
+        if downtime is None or not any(
+                s > 0 and abs(s - downtime) <= 500.0 for s in span_ms):
+            raise RuntimeError(
+                f"fleet gate: migration arrow span {span_ms} ms does not "
+                f"match the measured downtime {downtime} ms (+/- 500 ms)")
+        out_path = os.environ.get("BGT_FLEET_TRACE_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(merged, f, default=repr)
+
+        # observer ingest cost: folding one heartbeat + an SLO evaluation
+        # must stay under 1% of the heartbeat cadence (a fleet of hundreds
+        # of workers cannot make the scheduler's poll loop miss beats)
+        probe = FleetObserver()
+        synth = {
+            "lobbies": {f"L{i}": {"frame": 0, "state": "running"}
+                        for i in range(4)},
+            "lobby_qos_score": {f"L{i}": 90.0 - i for i in range(4)},
+            "shard_imbalance_ratio": 1.1,
+            "device_resident_bytes": 1 << 20,
+        }
+        n_beats = 50 if smoke else 200
+        t0 = time.perf_counter()
+        for k in range(n_beats):
+            synth["lobbies"]["L0"]["frame"] = k
+            probe.ingest_heartbeat(f"w{k % 4}", synth, assigned_slots=3)
+            probe.evaluate()
+        ingest_ms = (time.perf_counter() - t0) * 1000.0 / n_beats
+        ingest_budget_ms = HEARTBEAT_S * 1000.0 * 0.01
+        if ingest_ms >= ingest_budget_ms:
+            raise RuntimeError(
+                f"fleet gate: observer ingest+evaluate costs "
+                f"{ingest_ms:.3f} ms/heartbeat (required: < "
+                f"{ingest_budget_ms:.2f} ms = 1% of the {HEARTBEAT_S}s "
+                "heartbeat cadence)")
+
         # gate 1: zero desyncs vs in-process controls
         desyncs = []
         for spec in specs:
@@ -1850,11 +2024,12 @@ def stage_fleet():
                 f"match their unmigrated controls: {desyncs}"
             )
 
-        mig_events = [e for e in sched.events if e["event"] == "migrate_ok"]
-        downtime = mig_events[-1]["downtime_ms"] if mig_events else None
         reject_series = (telemetry.summary()["metrics"]
                          .get("admission_rejects_total", {})
                          .get("series", {}))
+        alert_series = (telemetry.summary()["metrics"]
+                        .get("fleet_alerts_total", {})
+                        .get("series", {}))
         return {
             "fleet_workers_spawned": 2,
             "fleet_lobbies": FLEET_LOBBIES,
@@ -1866,15 +2041,31 @@ def stage_fleet():
             "fleet_failover_frames": [e.get("frame") for e in failovers],
             "fleet_admission_rejects": reject_series,
             "fleet_desyncs": 0,
+            "fleet_alert_latency_ms": round(fleet_alert_latency_ms, 1),
+            "fleet_alerts_total": alert_series,
+            "fleet_observer_ingest_ms": round(ingest_ms, 4),
+            "fleet_observer_ingest_budget_ms": round(ingest_budget_ms, 3),
+            "fleet_merged_trace_events": len(evs),
+            "fleet_merged_trace_pids": len({e.get("pid") for e in evs
+                                            if e.get("pid") is not None}),
+            "fleet_wire_instants": len(wire_instants),
+            "fleet_migration_arrow_span_ms": [round(s, 1) for s in span_ms],
+            "fleet_qos_worst": qos_json.get("worst_lobbies", [])[:3],
             "fleet_events": [e["event"] for e in sched.events],
             "platform": jax.devices()[0].platform,
         }
     finally:
         for p in procs.values():
             if p.poll() is None:
+                try:
+                    os.kill(p.pid, signal.SIGCONT)  # a stopped child
+                except OSError:                     # ignores SIGKILL
+                    pass
                 p.kill()
                 p.wait()
         sched.close()
+        exporter.close()
+        shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 STAGES = {

@@ -13,6 +13,9 @@ The packages below promote the single-process room server
 - :mod:`.scheduler` — the matchmaker: QoS/bytes-aware greedy placement,
   wire-visible admission control, drain-at-barrier live migration, and
   heartbeat-timeout failover from last-confirmed checkpoints.
+- :mod:`.observe` — scheduler-side federation: heartbeat-derived metric
+  time-series, SLO burn-rate alerting, and the fleet HTTP surface
+  (``/fleet``, ``/qos``, federated ``/metrics``).
 
 See docs/architecture.md "Fleet scheduling & migration" for the lifecycle
 diagrams and docs/observability.md for the ``fleet_*`` metric families."""
@@ -26,11 +29,27 @@ from .lobby import (
     spec_est_bytes,
     synthetic_inputs,
 )
+from .observe import (
+    AlertEvent,
+    FleetObserver,
+    SLO,
+    SeriesRing,
+    default_slos,
+    fleet_routes,
+    start_fleet_exporter,
+)
 from .protocol import ChunkAssembler, Msg, chunk_checkpoint, decode
 from .scheduler import FleetClient, FleetScheduler, LobbyRecord, WorkerInfo
 from .worker import FleetWorker
 
 __all__ = [
+    "AlertEvent",
+    "FleetObserver",
+    "SLO",
+    "SeriesRing",
+    "default_slos",
+    "fleet_routes",
+    "start_fleet_exporter",
     "APP_CATALOG",
     "LOBBY_CHUNK",
     "LobbySim",

@@ -14,6 +14,10 @@ type      dir      payload
 ========  =======  ====================================================
 REGISTER  w -> s   worker_id, capacity (u16) — (re-)announce a worker
 HEARTBEAT w -> s   worker_id, JSON stats (lobbies, qos, bytes, ratio)
+HB_SEQ    w -> s   worker_id, seq (u32), stats digest — liveness-only
+                   heartbeat when the stats payload is unchanged (the
+                   scheduler refreshes last-seen iff the digest matches
+                   the stats it already holds)
 PLACE     s -> w   lobby_id, JSON LobbySpec — host this lobby from 0
 PLACE_OK  w -> s   lobby_id, frame (u32) — lobby is running
 DRAIN     s -> w   lobby_id, barrier frame (u32) — stop AT barrier,
@@ -41,6 +45,7 @@ datagrams, checkpoint chunks) stays binary.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import logging
 import struct
@@ -96,6 +101,7 @@ T_DROP = 41
 T_SUBMIT = 42
 T_SUBMIT_OK = 43
 T_DONE = 44
+T_HEARTBEAT_SEQ = 45
 # admission rejects reuse the room server's reject type so a fleet client
 # shares the room client's "refused, here is why" handling
 T_REJECT = 8
@@ -165,6 +171,26 @@ def encode_heartbeat(worker_id: str, stats: dict) -> bytes:
     """HEARTBEAT: the worker's live load/QoS report (JSON tail)."""
     return (_HDR.pack(ROOM_MAGIC, T_HEARTBEAT) + _pack_str(worker_id)
             + _pack_json(stats))
+
+
+def stats_digest(stats: dict) -> str:
+    """Canonical digest of a heartbeat stats payload.
+
+    Both ends hash the same canonical JSON (:func:`_json_str` — sorted
+    keys, tight separators, round-trip stable for JSON scalars), so the
+    worker's digest of what it sent equals the scheduler's digest of what
+    it decoded; a HB_SEQ datagram then proves "stats unchanged" without
+    re-shipping them."""
+    return hashlib.blake2b(
+        _json_str(stats).encode("utf-8"), digest_size=8
+    ).hexdigest()
+
+
+def encode_heartbeat_seq(worker_id: str, seq: int, digest: str) -> bytes:
+    """HB_SEQ: liveness-only heartbeat — the stats payload is unchanged
+    since the last full HEARTBEAT (``digest`` proves which one)."""
+    return (_HDR.pack(ROOM_MAGIC, T_HEARTBEAT_SEQ) + _pack_str(worker_id)
+            + _pack_u32(seq) + _pack_str(digest))
 
 
 def encode_place(lobby_id: str, spec: dict) -> bytes:
@@ -261,6 +287,13 @@ def decode(data: bytes) -> Optional[Msg]:
         if not r.ok or not wid or not isinstance(obj, dict):
             return None
         return Msg(t, a=wid, obj=obj)
+    if t == T_HEARTBEAT_SEQ:
+        wid = r.s()
+        seq = _u32(r)
+        dig = r.s()
+        if not r.ok or not wid or not dig:
+            return None
+        return Msg(t, a=wid, b=dig, seq=seq)
     if t in (T_PLACE, T_RESUME, T_SUBMIT):
         lid = r.s()
         frame = _u32(r) if t == T_RESUME else 0

@@ -48,6 +48,7 @@ from .. import telemetry
 from ..telemetry.metrics import LATENCY_MS_BUCKETS
 from . import protocol as P
 from .lobby import LobbySpec, spec_est_bytes
+from .observe import FleetObserver
 
 log = logging.getLogger("bevy_ggrs_tpu.fleet.scheduler")
 
@@ -68,6 +69,9 @@ class WorkerInfo:
     capacity: int
     last_seen: float
     stats: dict = dataclasses.field(default_factory=dict)
+    # canonical digest of ``stats`` — HB_SEQ liveness refreshes must prove
+    # they describe the payload we already hold (fleet/protocol.py)
+    stats_digest: str = ""
 
     def lobby_frames(self) -> Dict[str, int]:
         """Per-lobby frames from the latest heartbeat."""
@@ -115,7 +119,8 @@ class FleetScheduler:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  worker_timeout_s: float = WORKER_TIMEOUT_S,
-                 mem_budget_bytes: int = DEFAULT_MEM_BUDGET):
+                 mem_budget_bytes: int = DEFAULT_MEM_BUDGET,
+                 observer: Optional[FleetObserver] = None):
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
         self._sock.setblocking(False)
         self._sock.bind((host, port))
@@ -127,6 +132,8 @@ class FleetScheduler:
         # lobby_id -> client addr awaiting SUBMIT_OK/REJECT
         self._submitters: Dict[str, Tuple[str, int]] = {}
         self.events: List[dict] = []  # placement/migration/reject audit log
+        # federation read side: heartbeat time-series + SLO burn alerts
+        self.observer = observer if observer is not None else FleetObserver()
 
     @property
     def local_addr(self) -> Tuple[str, int]:
@@ -152,6 +159,14 @@ class FleetScheduler:
 
     def _event(self, kind: str, **fields) -> None:
         self.events.append({"event": kind, **fields})
+
+    def _wire(self, op: str, lid: str = "", worker: str = "",
+              frame: int = 0) -> None:
+        """Stamp one control-plane wire event onto the timeline's scheduler
+        track — the N-way trace merge pairs these with the workers' side
+        into flow arrows and clock-alignment anchors (telemetry/trace.py)."""
+        telemetry.record("fleet_wire", track="scheduler", op=op, lid=lid,
+                         worker=worker, frame=frame)
 
     # -- placement ---------------------------------------------------------
 
@@ -248,6 +263,7 @@ class FleetScheduler:
         self._send_worker(
             rec.worker_id, P.encode_place(rec.lobby_id, rec.spec.to_json())
         )
+        self._wire("PLACE", rec.lobby_id, rec.worker_id)
 
     def drop(self, lobby_id: str) -> bool:
         """Tear a lobby down: DROP to its worker, forget the record (frees
@@ -295,6 +311,7 @@ class FleetScheduler:
         self._send_worker(
             rec.worker_id, P.encode_drain(lobby_id, rec.mig_barrier)
         )
+        self._wire("DRAIN", lobby_id, rec.worker_id, rec.mig_barrier)
         self._event("migrate_start", lobby=lobby_id, src=rec.worker_id,
                     dst=dst, barrier=rec.mig_barrier)
         log.info("migrating lobby %s: %s -> %s (barrier %d)",
@@ -307,6 +324,7 @@ class FleetScheduler:
         self._send_worker(rec.mig_dst, P.encode_resume(
             rec.lobby_id, rec.ckpt_frame, rec.spec.to_json()
         ))
+        self._wire("RESUME", rec.lobby_id, rec.mig_dst, rec.ckpt_frame)
         for d in P.chunk_checkpoint(rec.lobby_id, rec.ckpt_frame,
                                     rec.ckpt_blob):
             self._send_worker(rec.mig_dst, d)
@@ -323,9 +341,11 @@ class FleetScheduler:
         self._event("migrate_ok", lobby=rec.lobby_id, src=src,
                     dst=rec.mig_dst, frame=resumed_frame,
                     downtime_ms=round(downtime_ms, 3))
+        self.observer.note_migration(rec.lobby_id, downtime_ms, now)
         log.info("migrated lobby %s: %s -> %s at frame %d (%.1f ms down)",
                  rec.lobby_id, src, rec.mig_dst, resumed_frame, downtime_ms)
         self._send_worker(src, P.encode_drop(rec.lobby_id))
+        self._wire("DROP", rec.lobby_id, src, resumed_frame)
         rec.worker_id = rec.mig_dst
         rec.state = "running"
         rec.frame = resumed_frame
@@ -342,6 +362,8 @@ class FleetScheduler:
             return
         log.warning("worker %s timed out; failing over its lobbies", wid)
         self._event("worker_dead", worker=wid)
+        self._wire("FAILOVER", worker=wid)
+        self.observer.forget_worker(wid, time.monotonic())
         for rec in list(self.lobbies.values()):
             if rec.worker_id != wid and rec.mig_dst != wid:
                 continue
@@ -395,6 +417,7 @@ class FleetScheduler:
             self.workers[msg.a] = WorkerInfo(
                 worker_id=msg.a, addr=addr, capacity=msg.total,
                 last_seen=now, stats=w.stats if w else {},
+                stats_digest=w.stats_digest if w else "",
             )
             # ack by echoing a heartbeat-shaped no-op? not needed: any
             # PLACE/heartbeat response proves liveness; workers treat any
@@ -410,10 +433,25 @@ class FleetScheduler:
             w.addr = addr
             w.last_seen = now
             w.stats = msg.obj or {}
+            w.stats_digest = P.stats_digest(w.stats)
             for lid, frame in w.lobby_frames().items():
                 rec = self.lobbies.get(lid)
                 if rec is not None and rec.worker_id == msg.a:
                     rec.frame = max(rec.frame, frame)
+            self.observer.ingest_heartbeat(
+                msg.a, w.stats, now,
+                assigned_slots=len(self._assigned(msg.a)),
+            )
+        elif msg.kind == P.T_HEARTBEAT_SEQ:
+            w = self.workers.get(msg.a)
+            # liveness refresh iff the digest proves the stats we already
+            # hold; unknown workers / stale digests are ignored — the
+            # worker's periodic forced full heartbeat re-adopts within
+            # FULL_HEARTBEAT_EVERY beats (fleet/worker.py)
+            if w is not None and msg.b == w.stats_digest:
+                w.addr = addr
+                w.last_seen = now
+                self.observer.ingest_liveness(msg.a, now)
         elif msg.kind == P.T_PLACE_OK:
             rec = self.lobbies.get(msg.a)
             if rec is not None and rec.state == "placing":
@@ -481,6 +519,9 @@ class FleetScheduler:
             # the barrier checkpoint is in hand: downtime clock starts now
             rec.mig_t_ckpt = now
             rec.mig_phase = "resuming"
+            # the CKPT instant anchors the downtime-spanning flow arrow
+            # (CKPT -> destination RESUME_OK) in merged fleet traces
+            self._wire("CKPT", rec.lobby_id, rec.worker_id, msg.frame)
             self._ship_resume(rec)
 
     # -- main loop ---------------------------------------------------------
@@ -528,6 +569,9 @@ class FleetScheduler:
         self._retries(now)
         telemetry.gauge_set("fleet_workers", len(self.workers),
                             help="live registered fleet workers")
+        # throttled SLO evaluation + /fleet topology refresh (the observer
+        # no-ops until its eval interval elapses)
+        self.observer.tick(now, topology=self.snapshot)
 
     def run(self, duration_s: Optional[float] = None,
             idle_sleep_s: float = 0.005) -> None:
@@ -566,6 +610,16 @@ class FleetScheduler:
             },
             "events": list(self.events),
         }
+
+    def fleet_snapshot(self, tail: int = 32) -> dict:
+        """The federated ``/fleet`` JSON (fleet/observe.py schema): refresh
+        the observer's topology from this thread's live state, then return
+        its snapshot.  HTTP handler threads must NOT call this — they use
+        ``observer.fleet_snapshot()`` directly (topology is refreshed by
+        :meth:`poll` at the observer's eval cadence), because only the poll
+        thread may read ``self.workers`` / ``self.lobbies``."""
+        self.observer.set_topology(self.snapshot())
+        return self.observer.fleet_snapshot(tail=tail)
 
 
 class FleetClient:

@@ -39,6 +39,10 @@ log = logging.getLogger("bevy_ggrs_tpu.fleet.worker")
 HEARTBEAT_S = 0.25  # control-plane cadence (low-rate by design)
 CKPT_RESHIP_S = 0.5  # unacked checkpoint retry interval
 CKPT_EVERY_FRAMES = 120  # periodic confirmed-checkpoint cadence
+# digest-suppressed heartbeats: force a full stats payload every N beats so
+# a lost full (or a restarted scheduler that never saw one) self-heals
+# within N * heartbeat_s instead of stranding liveness on a stale digest
+FULL_HEARTBEAT_EVERY = 8
 
 
 @dataclasses.dataclass
@@ -107,6 +111,11 @@ class FleetWorker:
         self._assembler = P.ChunkAssembler()
         self._last_heartbeat = 0.0
         self._registered_ack = False
+        # heartbeat suppression state: last full stats payload + its digest
+        self._last_stats: Optional[dict] = None
+        self._last_digest = ""
+        self._hb_seq = 0
+        self._beats_since_full = 0
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
         self._sock.setblocking(False)
         self._sock.bind((host, port))
@@ -127,6 +136,13 @@ class FleetWorker:
             self._sock.sendto(data, self.scheduler_addr)
         except OSError:
             pass  # scheduler gone; heartbeat/reship timers keep retrying
+
+    def _wire(self, op: str, lid: str = "", frame: int = 0) -> None:
+        """Stamp one control-plane wire event onto the timeline — merged
+        fleet traces pair these with the scheduler's side into flow arrows
+        (telemetry/trace.py) and use the pairs for clock alignment."""
+        telemetry.record("fleet_wire", track=f"worker:{self.worker_id}",
+                         op=op, lid=lid, frame=frame)
 
     def register(self) -> None:
         """(Re-)announce this worker; repeated until the scheduler talks
@@ -165,7 +181,26 @@ class FleetWorker:
         self._last_heartbeat = now
         if not self._registered_ack:
             self.register()
-        self._send(P.encode_heartbeat(self.worker_id, self._stats()))
+        stats = self._stats()
+        self._hb_seq += 1
+        if (stats == self._last_stats
+                and self._beats_since_full < FULL_HEARTBEAT_EVERY):
+            # unchanged payload: skip the JSON re-serialize and ship a
+            # liveness-only HB_SEQ carrying the last full payload's digest
+            self._beats_since_full += 1
+            self._send(P.encode_heartbeat_seq(
+                self.worker_id, self._hb_seq, self._last_digest
+            ))
+            telemetry.count(
+                "fleet_heartbeat_suppressed_total",
+                help="liveness-only heartbeats sent in place of an "
+                     "unchanged stats payload",
+            )
+        else:
+            self._last_stats = stats
+            self._last_digest = P.stats_digest(stats)
+            self._beats_since_full = 0
+            self._send(P.encode_heartbeat(self.worker_id, stats))
         # re-announce finished lobbies at heartbeat cadence: DONE has no
         # ack type, so a lost datagram must not strand the scheduler in
         # "running" forever (the lobby stays hosted until DROP anyway)
@@ -196,6 +231,7 @@ class FleetWorker:
             if msg.a in self.lobbies:
                 log.info("worker %s: dropping lobby %s", self.worker_id, msg.a)
                 del self.lobbies[msg.a]
+                self._wire("DROP_RECV", msg.a)
             self._resuming.pop(msg.a, None)
 
     def _on_place(self, msg: P.Msg) -> None:
@@ -208,6 +244,7 @@ class FleetWorker:
         log.info("worker %s: placed lobby %s (%s, %d entities)",
                  self.worker_id, msg.a, spec.app, spec.entities)
         self._send(P.encode_place_ok(msg.a, sim.frame))
+        self._wire("PLACE_OK", msg.a, sim.frame)
 
     def _on_drain(self, msg: P.Msg) -> None:
         h = self.lobbies.get(msg.a)
@@ -242,6 +279,7 @@ class FleetWorker:
         log.info("worker %s: resumed lobby %s at frame %d",
                  self.worker_id, msg.a, sim.frame)
         self._send(P.encode_resume_ok(msg.a, sim.frame))
+        self._wire("RESUME_OK", msg.a, sim.frame)
         # a restore (app build + first-step compile) can stall this worker
         # past the scheduler's heartbeat timeout; heartbeat immediately so
         # the stall window is as small as the work, not work + cadence
@@ -295,6 +333,7 @@ class FleetWorker:
             self._cut_shipment(lid, h)
             self._reship(h, time.monotonic(), force=True)
             h.state = "drained"
+            self._wire("DRAINED", lid, h.barrier)
             log.info("worker %s: drained lobby %s at barrier %d",
                      self.worker_id, lid, h.barrier)
             return

@@ -24,7 +24,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from .metrics import MetricsRegistry, registry as _default_registry
 
@@ -42,8 +42,16 @@ class MetricsExporter:
     """Background HTTP server exposing one registry (see module docstring)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 extra_json_routes: Optional[
+                     Dict[str, Callable[[], dict]]] = None):
         reg = registry if registry is not None else _default_registry()
+        # path -> zero-arg callable returning a JSON-able payload; checked
+        # BEFORE the builtin paths so a caller can override them (the fleet
+        # exporter replaces /qos with the fleet-wide worst-N view and adds
+        # /fleet — fleet/observe.py).  Callables run on handler threads and
+        # must be thread-safe.
+        extra = dict(extra_json_routes or {})
 
         class Handler(BaseHTTPRequestHandler):
             """Per-scrape request handler (``/metrics`` + ``/`` index)."""
@@ -51,7 +59,12 @@ class MetricsExporter:
             def do_GET(self):  # noqa: N802 (stdlib naming)
                 """Serve exposition text (``/metrics``) or QoS JSON (``/qos``)."""
                 path, _, query = self.path.partition("?")
-                if path == "/qos":
+                if path in extra:
+                    body = json.dumps(
+                        extra[path](), default=repr
+                    ).encode("utf-8")
+                    ctype = QOS_CONTENT_TYPE
+                elif path == "/qos":
                     from .qos import update_qos_gauges
 
                     body = json.dumps(update_qos_gauges(reg)).encode("utf-8")
@@ -106,6 +119,10 @@ class MetricsExporter:
 
 
 def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
-                        registry: Optional[MetricsRegistry] = None) -> MetricsExporter:
+                        registry: Optional[MetricsRegistry] = None,
+                        extra_json_routes: Optional[
+                            Dict[str, Callable[[], dict]]] = None,
+                        ) -> MetricsExporter:
     """Start a :class:`MetricsExporter`; returns it (``.port``, ``.close()``)."""
-    return MetricsExporter(port=port, host=host, registry=registry)
+    return MetricsExporter(port=port, host=host, registry=registry,
+                           extra_json_routes=extra_json_routes)

@@ -48,6 +48,7 @@ from .timeline import timeline as _get_timeline
 _INSTANT_KINDS = (
     "stall", "checksum_mismatch", "desync_report", "spectator_catchup",
     "dispatch", "network_stats", "rollback", "input_send",
+    "fleet_wire", "fleet_alert",
 )
 
 
@@ -55,7 +56,12 @@ def _tid_for(ev: dict, tids: Dict[Tuple, int], names: List[dict],
              pid: int) -> int:
     """Stable small-int track id for an event's owner/lobby, registering a
     ``thread_name`` metadata event on first sight."""
-    if ev.get("lobby") is not None:
+    if ev.get("track") is not None:
+        # explicit track label: the fleet control plane pins its wire/alert
+        # instants to a "scheduler" / "worker:<id>" track
+        key = ("track", ev["track"])
+        label = str(ev["track"])
+    elif ev.get("lobby") is not None:
         key = ("lobby", ev["lobby"])
         label = f"lobby {ev['lobby']}"
     elif ev.get("owner") is not None:
@@ -170,7 +176,7 @@ def chrome_trace(
         elif kind in _INSTANT_KINDS:
             tid = _tid_for(e, tids, meta_events, pid)
             args = {k: v for k, v in e.items()
-                    if k not in ("seq", "t", "kind", "lobby")}
+                    if k not in ("seq", "t", "kind", "lobby", "track")}
             out.append({"ph": "i", "s": "t", "name": kind, "ts": us(e["t"]),
                         "pid": pid, "tid": tid, "args": args})
     if not have_tl_rollbacks:
@@ -284,52 +290,177 @@ def trace_from_report(report: dict, *, pid: int = 1,
     )
 
 
-def merge_traces(trace_a: dict, trace_b: dict) -> dict:
-    """Merge two peers' traces into one, clock-aligned and flow-correlated.
+#: (scheduler send op, worker completion op, flow label): the fleet wire
+#: pairs the merged view links with flow arrows.  The CKPT -> RESUME_OK
+#: "migration" arrow spans exactly the measured migration downtime —
+#: barrier-checkpoint-in-hand to restored-on-destination.
+_FLEET_FLOW_PAIRS = (
+    ("CKPT", "RESUME_OK", "migration"),
+    ("PLACE", "PLACE_OK", "place"),
+    ("DRAIN", "DRAINED", "drain"),
+)
 
-    The peers' ``perf_counter`` clocks share no epoch, so ``b``'s events
-    are shifted by the median offset between the two sides' ``tick`` slices
-    for the same frame (the ``forensics.merge_reports`` frame-alignment
-    idea).  After alignment, cross-peer flow arrows are added: each
-    rollback instant on one peer is linked to the OTHER peer's
-    ``input_send`` for the blamed ``(handle, frame)``."""
-    ev_a = [dict(e) for e in trace_a.get("traceEvents", [])]
-    ev_b = [dict(e) for e in trace_b.get("traceEvents", [])]
-    for e in ev_a + ev_b:
-        # drop stale in-process flow stamps: the merged view re-pairs
-        # cross-pid only, and flows() must not see the old ids
-        a = e.get("args")
-        if a and "flow_id" in a:
-            e["args"] = {k: v for k, v in a.items() if k != "flow_id"}
-    pids_a = {e.get("pid") for e in ev_a}
-    if pids_a & {e.get("pid") for e in ev_b}:
-        shift = max((p for p in pids_a if p is not None), default=0) + 1
-        for e in ev_b:
-            if e.get("pid") is not None:
-                e["pid"] = e["pid"] + shift
+#: worker completion op -> the scheduler send op it answers (clock
+#: alignment bounds for traces that share no tick frames)
+_WIRE_RESP = {
+    "PLACE_OK": "PLACE", "DRAINED": "DRAIN",
+    "RESUME_OK": "RESUME", "DROP_RECV": "DROP",
+}
 
-    def _tick_ts(evs: List[dict]) -> Dict[int, float]:
-        return {e["args"]["frame"]: e["ts"] for e in evs
-                if e.get("ph") == "X" and e.get("name") == "tick"
-                and e.get("args", {}).get("frame") is not None}
 
-    ta, tb = _tick_ts(ev_a), _tick_ts(ev_b)
-    common = sorted(set(ta) & set(tb))
-    if common:
-        offsets = sorted(ta[f] - tb[f] for f in common)
-        off = offsets[len(offsets) // 2]
-        for e in ev_b:
-            if "ts" in e:
-                e["ts"] = round(e["ts"] + off, 3)
+def _tick_ts(evs: List[dict]) -> Dict[int, float]:
+    """frame -> tick-slice ts (the cross-peer alignment anchors)."""
+    return {e["args"]["frame"]: e["ts"] for e in evs
+            if e.get("ph") == "X" and e.get("name") == "tick"
+            and e.get("args", {}).get("frame") is not None}
 
-    merged = [e for e in ev_a + ev_b if e.get("ph") != "s" and e.get("ph") != "f"]
+
+def _wire_ts(evs: List[dict]) -> Dict[Tuple, List[float]]:
+    """(lid, op) -> sorted ``fleet_wire`` instant timestamps."""
+    d: Dict[Tuple, List[float]] = {}
+    for e in evs:
+        if e.get("ph") != "i" or e.get("name") != "fleet_wire":
+            continue
+        a = e.get("args", {})
+        d.setdefault((a.get("lid"), a.get("op")), []).append(e["ts"])
+    return {k: sorted(v) for k, v in d.items()}
+
+
+def _wire_offset(base: List[dict], new: List[dict]) -> Optional[float]:
+    """Clock offset (added to ``new``'s ts) from matched fleet wire
+    send/completion pairs — the alignment fallback when the traces share
+    no tick frames (a scheduler trace has no tick slices at all).
+
+    A completion happens after its send in real time, so every matched
+    pair bounds the offset from one side: a send in ``base`` answered in
+    ``new`` gives a lower bound, the mirrored direction an upper bound.
+    Taking the tightest bounds makes the estimation error the smallest
+    send->completion processing delay among the matched pairs (the DROP ->
+    DROP_RECV pair is usually one poll quantum)."""
+    ca, cb = _wire_ts(base), _wire_ts(new)
+    lowers: List[float] = []  # off >= ts_send(base) - ts_completion(new)
+    uppers: List[float] = []  # off <= ts_completion(base) - ts_send(new)
+    for (lid, resp_op), resp_ts in cb.items():
+        send_ts = ca.get((lid, _WIRE_RESP.get(resp_op)))
+        if send_ts:
+            lowers.extend(s - r for s, r in zip(send_ts, resp_ts))
+    for (lid, resp_op), resp_ts in ca.items():
+        send_ts = cb.get((lid, _WIRE_RESP.get(resp_op)))
+        if send_ts:
+            uppers.extend(r - s for s, r in zip(send_ts, resp_ts))
+    if lowers and uppers:
+        return (max(lowers) + min(uppers)) / 2.0
+    if lowers:
+        return max(lowers)
+    if uppers:
+        return min(uppers)
+    return None
+
+
+def _fleet_flow_events(events: List[dict], start_id: int = 1) -> List[dict]:
+    """Cross-pid flow pairs linking scheduler ``fleet_wire`` commands to
+    the worker-side completions (:data:`_FLEET_FLOW_PAIRS`), matched by
+    lobby id in timestamp order.  Stamps ``flow_id`` into both instants'
+    args like :func:`_flow_events` does for input flows."""
+    wires = [e for e in events
+             if e.get("ph") == "i" and e.get("name") == "fleet_wire"]
+    flows: List[dict] = []
+    fid = start_id
+    for src_op, dst_op, label in _FLEET_FLOW_PAIRS:
+        srcs = sorted((e for e in wires
+                       if e.get("args", {}).get("op") == src_op),
+                      key=lambda e: e["ts"])
+        dsts = sorted((e for e in wires
+                       if e.get("args", {}).get("op") == dst_op),
+                      key=lambda e: e["ts"])
+        used = set()
+        for s in srcs:
+            lid = s.get("args", {}).get("lid")
+            for j, d in enumerate(dsts):
+                if j in used or d.get("args", {}).get("lid") != lid:
+                    continue
+                if d.get("pid") == s.get("pid") or d["ts"] < s["ts"]:
+                    continue
+                common = {"cat": "fleet_flow", "name": label, "id": fid}
+                flows.append({"ph": "s", "ts": s["ts"], "pid": s["pid"],
+                              "tid": s["tid"], **common})
+                flows.append({"ph": "f", "bp": "e", "ts": d["ts"],
+                              "pid": d["pid"], "tid": d["tid"], **common})
+                s["args"]["flow_id"] = fid
+                d["args"]["flow_id"] = fid
+                used.add(j)
+                fid += 1
+                break
+    return flows
+
+
+def merge_traces(trace_a: dict, trace_b: dict, *more: dict) -> dict:
+    """Merge N participants' traces into one, clock-aligned and
+    flow-correlated (two-peer calls behave exactly as before).
+
+    The FIRST trace is the clock reference; every other trace is shifted
+    onto it — for a fleet merge pass the scheduler first, then the
+    workers.  Alignment per trace: the median offset over tick slices for
+    common frames when the pair shares any (the two-peer desync-forensics
+    case), else matched ``fleet_wire`` send/completion pairs
+    (:func:`_wire_offset` — workers share wire events with the scheduler,
+    never tick frames).  Pids are shifted on collision so each participant
+    keeps its own process lane.
+
+    After alignment two flow families are re-paired cross-pid: rollback ->
+    ``input_send`` blame arrows (:func:`_flow_events`) and scheduler ->
+    worker fleet wire arrows (:func:`_fleet_flow_events`) — the
+    ``migration`` arrow spans the measured downtime gap end-to-end."""
+    traces = [trace_a, trace_b, *more]
+    parts = [[dict(e) for e in t.get("traceEvents", [])] for t in traces]
+    for evs in parts:
+        for e in evs:
+            # drop stale in-process flow stamps: the merged view re-pairs
+            # cross-pid only, and flows() must not see the old ids
+            a = e.get("args")
+            if a and "flow_id" in a:
+                e["args"] = {k: v for k, v in a.items() if k != "flow_id"}
+    base = parts[0]
+    used_pids = {e.get("pid") for e in base if e.get("pid") is not None}
+    aligned = 0
+    for evs in parts[1:]:
+        pids = {e.get("pid") for e in evs if e.get("pid") is not None}
+        if pids & used_pids:
+            shift = max(used_pids, default=0) + 1
+            for e in evs:
+                if e.get("pid") is not None:
+                    e["pid"] = e["pid"] + shift
+            pids = {p + shift for p in pids}
+        used_pids |= pids
+        ta, tb = _tick_ts(base), _tick_ts(evs)
+        common = sorted(set(ta) & set(tb))
+        if common:
+            offsets = sorted(ta[f] - tb[f] for f in common)
+            off = offsets[len(offsets) // 2]
+            aligned += len(common)
+        else:
+            off = _wire_offset(base, evs)
+        if off is not None:
+            for e in evs:
+                if "ts" in e:
+                    e["ts"] = round(e["ts"] + off, 3)
+    merged = [e for evs in parts for e in evs
+              if e.get("ph") != "s" and e.get("ph") != "f"]
     merged.sort(key=lambda ev: (ev.get("ph") != "M", ev.get("ts", 0.0)))
-    merged.extend(_flow_events(merged, require_cross_pid=True))
+    input_flows = _flow_events(merged, require_cross_pid=True)
+    fleet_flows = _fleet_flow_events(
+        merged, start_id=1 + len(input_flows) // 2
+    )
+    merged.extend(input_flows)
+    merged.extend(fleet_flows)
+    metas = [t.get("metadata", {}) for t in traces]
     md = {
         "merged": True,
-        "aligned_frames": len(common),
-        "a": trace_a.get("metadata", {}),
-        "b": trace_b.get("metadata", {}),
+        "participants": len(traces),
+        "aligned_frames": aligned,
+        "a": metas[0],
+        "b": metas[1],
+        "parts": metas,
     }
     return {"traceEvents": merged, "displayTimeUnit": "ms", "metadata": md}
 

@@ -61,10 +61,11 @@ _EXCLUDE_RE = re.compile(r"(spread|bytes|pct|entities|depth|reps|lobbies)")
 # extra host->device upload or split a dispatch) — the speculation
 # stage's rollback-servicing p99s (bench.py _speculation_service_arm),
 # where an increase means rollback servicing got slower, and the fleet
-# stage's live-migration downtime (bench.py stage_fleet)
+# stage's live-migration downtime and SLO alert latency (bench.py
+# stage_fleet — stall-to-fire for the induced heartbeat_liveness breach)
 _FLOOR_RE = re.compile(r"(uploads_per_tick|dispatches_per_tick|"
                        r"uploads_per_flush|rollback_service_p99_ms|"
-                       r"migration_downtime_ms)")
+                       r"migration_downtime_ms|fleet_alert_latency_ms)")
 
 # ms-scale floors carry scheduling jitter that dwarfs their absolute size
 # (a 7ms -> 25ms migration downtime is +257% relative but meaningless);

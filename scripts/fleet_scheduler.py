@@ -5,19 +5,23 @@
 
 Workers (scripts/fleet_worker.py) register against the port; clients submit
 lobbies with SUBMIT datagrams (bevy_ggrs_tpu.fleet.FleetClient).  The 5 s
-reporting loop prints the placement snapshot and refreshes the ``fleet_*``
-gauges; with ``--metrics-port`` the registry is scrapable as Prometheus
-text (docs/observability.md "Fleet scheduling")."""
+reporting loop prints the federated ``/fleet`` snapshot (same schema the
+HTTP endpoint serves — one schema for CLI and scrapers); with
+``--metrics-port`` the registry is scrapable as Prometheus text plus the
+``/fleet`` and ``/qos`` JSON routes (docs/observability.md "Fleet
+federation & SLOs").  ``--status URL`` is a one-shot client mode: fetch a
+running scheduler's ``/fleet`` JSON, pretty-print it, exit."""
 
 import argparse
 import json
 import sys
 import time
+import urllib.request
 
 sys.path.insert(0, ".")
 
 from bevy_ggrs_tpu import telemetry
-from bevy_ggrs_tpu.fleet import FleetScheduler
+from bevy_ggrs_tpu.fleet import FleetScheduler, start_fleet_exporter
 
 
 def main() -> None:
@@ -32,20 +36,35 @@ def main() -> None:
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve Prometheus /metrics on this port")
     ap.add_argument("--metrics-host", default="127.0.0.1")
+    ap.add_argument("--status", metavar="URL", default=None,
+                    help="one-shot: fetch /fleet from a running scheduler's "
+                         "metrics endpoint (host:port or full URL), "
+                         "pretty-print, exit")
     args = ap.parse_args()
+    if args.status is not None:
+        url = args.status
+        if "://" not in url:
+            url = "http://" + url
+        if not url.rstrip("/").endswith("/fleet"):
+            url = url.rstrip("/") + "/fleet"
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            snap = json.load(resp)
+        json.dump(snap, sys.stdout, indent=2, sort_keys=True)
+        print()
+        return
     telemetry.enable()
-    exporter = None
-    if args.metrics_port is not None:
-        exporter = telemetry.start_http_exporter(
-            port=args.metrics_port, host=args.metrics_host
-        )
-        print(f"metrics on http://{args.metrics_host}:{exporter.port}"
-              f"/metrics", flush=True)
     sched = FleetScheduler(
         host=args.host, port=args.port,
         worker_timeout_s=args.worker_timeout,
         mem_budget_bytes=args.mem_budget_mb * 1024 * 1024,
     )
+    exporter = None
+    if args.metrics_port is not None:
+        exporter = start_fleet_exporter(
+            sched.observer, port=args.metrics_port, host=args.metrics_host
+        )
+        print(f"metrics on http://{args.metrics_host}:{exporter.port}"
+              f"/metrics (+ /fleet, /qos)", flush=True)
     print(f"fleet scheduler on {sched.local_addr}", flush=True)
     last_report = 0.0
     try:
@@ -54,10 +73,11 @@ def main() -> None:
             now = time.monotonic()
             if now - last_report >= 5.0:
                 last_report = now
-                snap = sched.snapshot()
+                snap = sched.fleet_snapshot(tail=4)
                 if snap["workers"] or snap["lobbies"]:
                     print(json.dumps(
-                        {k: snap[k] for k in ("workers", "lobbies")}
+                        {k: snap[k]
+                         for k in ("schema", "workers", "lobbies", "alerts")}
                     ), flush=True)
             time.sleep(0.002)
     except KeyboardInterrupt:

@@ -10,10 +10,17 @@ hosted lobbies by a bounded frame budget per poll, ships confirmed
 checkpoints back to the scheduler (the failover source), and heartbeats its
 load/QoS stats.  ``BGT_PLATFORM``/``JAX_PLATFORMS`` select the backend
 (bevy_ggrs_tpu/utils/platform.py).  The bench fleet stage spawns two of
-these and SIGKILLs one mid-game (bench.py stage_fleet)."""
+these and SIGKILLs one mid-game (bench.py stage_fleet).
+
+``--trace-out`` dumps this worker's Chrome trace periodically with an
+atomic replace, so the file is valid JSON even if the process is
+SIGKILLed mid-game — the bench fleet stage feeds the survivors' and the
+victim's last dumps into the N-way ``merge_traces``."""
 
 import argparse
+import os
 import sys
+import time
 
 sys.path.insert(0, ".")
 
@@ -41,6 +48,11 @@ def main() -> None:
     ap.add_argument("--pace-fps", type=float, default=0.0,
                     help="cap running lobbies to this realtime frame rate "
                          "(0 = unpaced)")
+    ap.add_argument("--trace-out", default=None,
+                    help="dump this worker's Chrome trace here periodically "
+                         "(atomic replace — survives SIGKILL)")
+    ap.add_argument("--trace-every", type=float, default=1.0,
+                    help="trace dump cadence with --trace-out (s)")
     args = ap.parse_args()
     telemetry.enable()
     host, _, port = args.scheduler.rpartition(":")
@@ -51,11 +63,34 @@ def main() -> None:
     )
     print(f"fleet worker {args.worker_id} on {worker.local_addr} -> "
           f"scheduler {args.scheduler}", flush=True)
+
+    def _dump_trace() -> None:
+        tmp = args.trace_out + ".tmp"
+        telemetry.write_trace(tmp, process_name=f"worker:{args.worker_id}")
+        os.replace(tmp, args.trace_out)
+
     try:
-        worker.run(duration_s=args.duration)
+        if args.trace_out is None:
+            worker.run(duration_s=args.duration)
+        else:
+            # manual run() loop so a reader always finds a complete trace
+            # file, even after this process is SIGKILLed mid-game
+            worker.register()
+            t0 = time.monotonic()
+            next_dump = t0 + args.trace_every
+            while (args.duration is None
+                   or time.monotonic() - t0 < args.duration):
+                worker.poll()
+                now = time.monotonic()
+                if now >= next_dump:
+                    next_dump = now + args.trace_every
+                    _dump_trace()
+                time.sleep(0.005)
     except KeyboardInterrupt:
         pass
     finally:
+        if args.trace_out is not None:
+            _dump_trace()
         worker.close()
 
 

@@ -72,6 +72,7 @@ CONCURRENCY_MODULES: Tuple[str, ...] = (
     "bevy_ggrs_tpu/fleet/worker.py",
     "bevy_ggrs_tpu/fleet/scheduler.py",
     "bevy_ggrs_tpu/fleet/protocol.py",
+    "bevy_ggrs_tpu/fleet/observe.py",
     "bevy_ggrs_tpu/telemetry/metrics.py",
     "bevy_ggrs_tpu/telemetry/prometheus.py",
     "scripts/room_server.py",
@@ -88,6 +89,13 @@ THREAD_ROOTS: Dict[str, Set[str]] = {
         "Histogram.observe", "Histogram.observe_key",
         "_Metric.series", "MetricsRegistry._get_or_create",
         "MetricsRegistry.metrics", "MetricsRegistry.render_prometheus",
+    },
+    # the fleet exporter's scrape threads call the observer's read surface
+    # (fleet/observe.py routes) while the scheduler poll thread ingests
+    "bevy_ggrs_tpu/fleet/observe.py": {
+        "FleetObserver.fleet_snapshot", "FleetObserver.fleet_qos",
+        "FleetObserver.active_alerts", "FleetObserver.alert_history",
+        "FleetObserver.window", "FleetObserver.rate",
     },
 }
 
