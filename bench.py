@@ -596,11 +596,16 @@ def _speculation_service_arm(jax, smoke):
     2. cache hit rate > 50% with the hold-last+hedged candidate set;
     3. steady census unchanged — the HIT pair's runner-level uploads still
        equal its dispatches (1+1 per fused advance), and every draft
-       dispatch rode exactly ONE packed upload."""
+       dispatch rode exactly ONE packed upload;
+    4. zero steady-state recompiles — both pairs' measured windows run
+       under the armed ``BGT_COMPILE_GUARD`` sentinel, so a fresh program
+       compile after warmup raises ``RecompileError`` naming the owner
+       and variant kind (the runtime twin of lint rules BGT070/BGT071)."""
     from bevy_ggrs_tpu import telemetry
     from bevy_ggrs_tpu.ops.speculation import (
         SpeculationConfig, pad_candidates,
     )
+    from bevy_ggrs_tpu.utils.compile_guard import set_compile_guard
 
     ticks = 60 if smoke else SVC_TICKS
     warm = 40 if smoke else SVC_WARM
@@ -631,7 +636,16 @@ def _speculation_service_arm(jax, smoke):
         dt = 1.0 / runners[0].app.fps
         _slice_ticks(jax, net, runners, warm, dt)
         telemetry.enable()
-        _slice_ticks(jax, net, runners, ticks, dt)
+        # the warm slice compiled every variant this workload can reach;
+        # a fresh compile in the measured window would both skew p99 and
+        # betray an unstable cache key — hard-fail via the armed guard
+        guard = set_compile_guard(True)
+        runners[0].arm_compile_guard()
+        try:
+            _slice_ticks(jax, net, runners, ticks, dt)
+        finally:
+            guard.disarm()
+            set_compile_guard(False)
         return runners
 
     # miss pair FIRST: its rollbacks populate path="miss" before the hit
@@ -1038,6 +1052,7 @@ MEGASTEP_FLUSHES = 16
 SANITIZER_CALLS = 20_000
 SANITIZER_MAX_OVERHEAD_PCT = 2.0
 SANITIZER_MAX_OFF_US = 1.5
+GUARD_MAX_OFF_US = 1.5
 
 
 def stage_uploads():
@@ -1062,7 +1077,17 @@ def stage_uploads():
     sanitizer (utils/staging.TransferSanitizer): a packed tick's whole
     ledger transaction is 4 hook calls (pack_prefix guard_write + commit's
     guard_write/begin/land), microbenchmarked armed and disarmed against
-    arm 1's measured tick wall.
+    arm 1's measured tick wall.  Arm 5 prices the ``BGT_COMPILE_GUARD``
+    steady-state recompile sentinel (utils/compile_guard) the same way:
+    disarmed, a ``notify()`` hook must collapse to one attribute check.
+
+    The arm-1 and arm-3 measured windows additionally run with the compile
+    guard ARMED: post-warmup the engine's variant set is closed, so any
+    fresh program compile inside the window raises ``RecompileError``
+    (naming the owning runner and variant kind — the runtime twin of lint
+    rules BGT070/BGT071) straight through the stage.  The megastep arm
+    stays unguarded: frame-advantage throttling legitimately compiles
+    fresh owed-count programs when the cadence jitters.
 
     HARD GATES (raise -> nonzero exit):
 
@@ -1073,10 +1098,14 @@ def stage_uploads():
     3. input queue — same 1+1 census as arm 1 over the rotating buffers;
     4. sanitizer — armed, the per-tick transaction is < 2% of the packed
        tick wall; disarmed (the default), < 1.5us per tick (the hooks
-       collapse to one attribute check each).
+       collapse to one attribute check each);
+    5. compile guard — zero steady-state recompiles in the guarded
+       windows; disarmed, notify() costs < 1.5us (one attribute check).
 
     ``BGT_BENCH_SMOKE=1`` shrinks the windows; all gates stay armed."""
     jax = _stage_setup()
+    from bevy_ggrs_tpu.utils import compile_guard
+    from bevy_ggrs_tpu.utils.compile_guard import set_compile_guard
 
     smoke = os.environ.get("BGT_BENCH_SMOKE", "") == "1"
     ticks = 50 if smoke else UPLOADS_TICKS
@@ -1092,7 +1121,14 @@ def stage_uploads():
                            "staging path")
     d0, u0, f0 = (r0.device_dispatches, r0.stats()["host_uploads"], r0.frame)
     b0 = r0.stats()["packed_upload_bytes"]
-    packed_wall = _slice_ticks(jax, net, runners, ticks, dt)
+    # post-warmup the variant set is closed: a fresh compile inside the
+    # measured window is a steady-state recompile and fails the stage
+    guard = set_compile_guard(True)
+    r0.arm_compile_guard()
+    try:
+        packed_wall = _slice_ticks(jax, net, runners, ticks, dt)
+    finally:
+        guard.disarm()
     st = r0.stats()
     packed_d = r0.device_dispatches - d0
     packed_u = st["host_uploads"] - u0
@@ -1149,7 +1185,11 @@ def stage_uploads():
     _slice_ticks(jax, net_q, q_runners, UPLOADS_WARM, dt)
     q0 = q_runners[0]
     d0, u0, f0 = (q0.device_dispatches, q0.stats()["host_uploads"], q0.frame)
-    _slice_ticks(jax, net_q, q_runners, ticks, dt)
+    q0.arm_compile_guard()
+    try:
+        _slice_ticks(jax, net_q, q_runners, ticks, dt)
+    finally:
+        guard.disarm()
     stq = q0.stats()
     queue_d = q0.device_dispatches - d0
     queue_u = stq["host_uploads"] - u0
@@ -1198,6 +1238,20 @@ def stage_uploads():
             f"check per hook (< {SANITIZER_MAX_OFF_US}us)"
         )
 
+    # -- arm 5: compile-guard disarmed overhead ---------------------------
+    steady_recompiles = len(guard.steady_compiles)
+    set_compile_guard(False)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        compile_guard.notify("bench", "exact:k1", 0.0)
+    guard_off_us = (time.perf_counter() - t0) / calls * 1e6
+    if guard_off_us >= GUARD_MAX_OFF_US:
+        raise RuntimeError(
+            f"uploads gate: DISABLED compile guard costs "
+            f"{guard_off_us:.2f}us per notify — the default path must stay "
+            f"one attribute check (< {GUARD_MAX_OFF_US}us)"
+        )
+
     return {
         "uploads_per_tick_packed": round(packed_u / packed_f, 3),
         "dispatches_per_tick_packed": round(packed_d / packed_f, 3),
@@ -1214,6 +1268,8 @@ def stage_uploads():
         "sanitizer_on_us_per_tick": round(san_on_us, 3),
         "sanitizer_off_us_per_tick": round(san_off_us, 3),
         "sanitizer_overhead_pct": round(san_pct, 3),
+        "compile_guard_steady_recompiles": steady_recompiles,
+        "compile_guard_off_us_per_notify": round(guard_off_us, 3),
         "uploads_rep_policy": (
             f"steady p2p census over {ticks} ticks after {UPLOADS_WARM} "
             f"warm; megastep census over {flushes} x {MEGASTEP_N}-frame "

@@ -80,6 +80,7 @@ from .snapshot.lazy import (
     readback_queue,
 )
 from .snapshot.ring import SnapshotRing, rollback_many
+from .utils import compile_guard
 from .utils.frames import NULL_FRAME, frame_add
 from .utils.tracing import span
 
@@ -1007,6 +1008,15 @@ class BatchedRunner:
                 "synctest_mismatch", reg=self.app.reg,
                 world=self.lobby_world(b), frames=e.mismatched_frames, lobby=b,
             )
+
+    def arm_compile_guard(self) -> bool:
+        """Declare warmup over: with ``BGT_COMPILE_GUARD=1`` (or
+        :func:`~bevy_ggrs_tpu.utils.compile_guard.set_compile_guard`) any
+        later wave-program compile raises
+        :class:`~bevy_ggrs_tpu.utils.compile_guard.RecompileError` naming
+        the owner/kind and bumps ``recompiles_steady_total{owner}``.
+        Returns True when armed; no-op (False) when the guard is off."""
+        return compile_guard.guard().arm()
 
     def stats(self) -> dict:
         """Driver + executor counters: ticks, rollbacks, device dispatches,

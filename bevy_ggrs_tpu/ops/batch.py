@@ -40,7 +40,7 @@ from .resim import resim, resim_padded
 
 def stack_worlds(worlds: List[WorldState]) -> WorldState:
     """Stack M structurally-identical worlds into one [M, ...] pytree."""
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *worlds)
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *worlds)  # bgt: ignore[BGT071]: len(worlds) is the caller's lobby count — bucketed to wave capacity before dispatch, so the traced length is shape-stable per bucket
 
 
 def unstack_world(batched: WorldState, i: int) -> WorldState:
@@ -442,6 +442,9 @@ class BucketedWaveExecutor:
             buckets=telemetry.LATENCY_MS_BUCKETS,
             owner=self._owner, kind=kind,
         )
+        from ..utils import compile_guard
+
+        compile_guard.notify(self._owner, kind, ms)
         return out
 
     def run_wave(self, worlds, inputs, status, starts, ks):

@@ -103,7 +103,7 @@ def probe_program_variants(
             if not np.array_equal(a, b):
                 mismatches += 1
                 if first is None:
-                    idx = np.argwhere(a != b)
+                    idx = np.argwhere(a != b)  # bgt: ignore[BGT071]: host-side numpy diagnostic on already-materialized arrays, never traced
                     first = {
                         "leaf": jax.tree_util.keystr(pa),
                         "a": a[tuple(idx[0])].item() if idx.size else None,

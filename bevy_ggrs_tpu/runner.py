@@ -43,6 +43,7 @@ from .snapshot.lazy import (
 from .snapshot.ring import SnapshotRing
 from .ops.resim import slice_frame
 from .ops.speculation import SpeculationCache, SpeculationConfig
+from .utils import compile_guard
 from .utils.frames import NULL_FRAME, frame_add
 from .utils.tracing import span, trace_log
 
@@ -1658,6 +1659,16 @@ class GgrsRunner:
             buckets=telemetry.LATENCY_MS_BUCKETS,
             owner="solo", kind=kind,
         )
+        compile_guard.notify("solo", kind, ms)
+
+    def arm_compile_guard(self) -> bool:
+        """Declare warmup over: with ``BGT_COMPILE_GUARD=1`` (or
+        :func:`~bevy_ggrs_tpu.utils.compile_guard.set_compile_guard`) any
+        later program compile raises
+        :class:`~bevy_ggrs_tpu.utils.compile_guard.RecompileError` naming
+        the owner/kind and bumps ``recompiles_steady_total{owner}``.
+        Returns True when armed; no-op (False) when the guard is off."""
+        return compile_guard.guard().arm()
 
     def _dispatch_branched(self, inputs, status, last_adv):
         """One canonical [B, K] dispatch: lane 0 = the real batch; hedge

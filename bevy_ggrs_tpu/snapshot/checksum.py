@@ -148,7 +148,7 @@ def resource_part(reg: Registry, w: WorldState, name: str, seed: int) -> jnp.nda
         lanes = jnp.ravel(spec.hash_fn(w.res[name])).astype(jnp.uint32)
     else:
         leaves = jax.tree.leaves(w.res[name])
-        lanes = jnp.concatenate(
+        lanes = jnp.concatenate(  # bgt: ignore[BGT071]: leaf count is fixed by the resource's registered pytree structure, not by array values
             [to_u32_lanes(jnp.atleast_1d(x)[None]).ravel() for x in leaves]
         )
     h = jnp.asarray(tag, jnp.uint32)
@@ -186,7 +186,7 @@ def world_checksum(reg: Registry, w: WorldState) -> jnp.ndarray:
             if spec.checksum:
                 part = part ^ resource_part(reg, w, name, seed)
         out.append(part)
-    return jnp.stack(out)
+    return jnp.stack(out)  # bgt: ignore[BGT071]: one entry per checksum-enabled registry leaf — length is fixed at registration, never data-dependent
 
 
 def checksum_to_int(cs) -> int:

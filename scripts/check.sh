@@ -4,14 +4,18 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== determinism analyzer (hard gate; JSON report next to bench artifacts) =="
-# 26 rules: hygiene, intra- + interprocedural hot-loop purity, phase-timer
-# discipline, metric/rule docs cross-checks, determinism hazards, and the
+# 30 rules: hygiene, intra- + interprocedural hot-loop purity, phase-timer
+# discipline, metric/rule docs cross-checks, determinism hazards, the
 # BGT06x concurrency/transfer-race block (shared-state locking, blocking-
-# under-lock, lock ordering, staging/donation races) — see
+# under-lock, lock ordering, staging/donation races), and the BGT07x
+# recompilation/shape-stability/engine-drift block — see
 # docs/static-analysis.md; scripts/lint_imports.py remains as a thin shim.
-# `python -m scripts.lint --changed` is the fast pre-commit slice; this
-# full run stays the authoritative gate
-python -m scripts.lint --json LINT_report.json
+# `python -m scripts.lint --changed` is the fast pre-commit slice; --cache
+# replays unchanged files from .lint_cache.json (agreement with the full
+# run is tested), so this stays the authoritative gate at slice cost.
+# --timings prints the per-rule-family wall-time table; the 10s budget is
+# a soft gate (warns, exit 0) — add --time-budget-hard to enforce.
+python -m scripts.lint --json LINT_report.json --cache --timings --time-budget 10
 
 echo "== native build + tests =="
 make -C native
@@ -49,7 +53,10 @@ echo "== bench smoke (batched + sharded + netstats + uploads + speculation + tra
 # the last confirmed checkpoint, or an admission reject that is not
 # wire-visible; the uploads stage additionally hard-fails unless the
 # BGT_SANITIZE transfer sanitizer costs <2% of the packed tick armed and
-# <1.5us disarmed
+# <1.5us disarmed; the uploads and speculation measured windows also run
+# under the armed BGT_COMPILE_GUARD sentinel — any steady-state recompile
+# raises RecompileError (runtime twin of lint BGT070/BGT071) and the
+# disarmed notify() hook must stay <1.5us (one attribute check)
 python bench.py --smoke
 
 echo "== bench =="

@@ -1,0 +1,280 @@
+"""Content-hash result cache — ``python -m scripts.lint --cache``.
+
+The full run costs ~3s, and almost all of it is re-analyzing files that
+have not changed since the last run.  This module keys a per-file result
+cache (``.lint_cache.json`` at the repo root) on two content hashes:
+
+* the **analyzer's own sources** (every ``scripts/lint/**/*.py``,
+  config included) — any rule or config edit invalidates everything;
+* each corpus file's **source hash** — an unchanged file's per-file
+  findings and consumed seed-line sanctions are replayed from the cache.
+
+Correctness is structural, not heuristic:
+
+* Changed files are expanded to the **bidirectional** import closure
+  (changed + transitive importers + transitive forward imports), so
+  every interprocedural chain rule (BGT011/BGT063/BGT071) sees both the
+  callers its findings land on and the callees its witness chains pass
+  through.  The sliced pass families are exactly the per-file /
+  chain-sound ones; their per-file findings are cacheable.
+* Whole-corpus rule families (metrics/trace-kind/docs catalogs, phase
+  discipline, concurrency scope, twin drift) run **fresh every time** —
+  their inputs include files outside the python corpus (docs tables),
+  so their findings are never cached.  So do the meta-rules (BGT005
+  stale suppressions, BGT012 stale allowlist), which reason about the
+  whole repo.
+* A changed file *set* (add/delete/rename) or analyzer hash miss falls
+  back to a plain full run and rebuilds the cache.
+
+The agreement contract is the same as ``--changed``'s: a cached run
+reports exactly what a full run would (test_lint.py proves it on a
+mutated corpus).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import core
+from .config import Config
+from .core import (
+    DEFAULT_PATHS,
+    Context,
+    Finding,
+    SourceFile,
+    apply_suppressions,
+    iter_py_files,
+    load_file,
+    timed_passes,
+)
+from .incremental import _imports_of
+
+CACHE_FILE = ".lint_cache.json"
+CACHE_VERSION = 1
+
+# pass families (module basenames) that are sound on a bidirectional
+# slice: per-file rules plus the chain rules whose witnesses resolve
+# within the closure
+SLICE_PASS_MODULES = frozenset({
+    "imports", "purity", "determinism", "transfer_race",
+    "jit_cache", "shape_stability", "dtype_drift",
+})
+# families that must see the whole corpus (or non-python inputs like the
+# docs catalogs) and therefore always run fresh
+FULL_PASS_MODULES = frozenset({
+    "phases", "metrics", "trace_kinds", "docs",
+    "shared_state", "locks", "lock_order", "twin_drift",
+})
+
+# rules whose findings are a pure function of one file plus its import
+# closure — the only ones a per-file cache entry may carry.  Everything
+# else (whole-corpus catalogs, BGT005/BGT012 meta-rules) is recomputed
+# on every cached run.
+CACHED_RULES = frozenset({
+    "BGT001", "BGT002", "BGT003", "BGT004",
+    "BGT010", "BGT011",
+    "BGT040", "BGT041", "BGT042", "BGT043", "BGT044",
+    "BGT063",
+    "BGT070", "BGT071", "BGT072",
+})
+
+_FINDING_KEYS = (
+    "rule", "path", "line", "message", "suppressed", "suppress_reason",
+)
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def ruleset_hash(root: Path) -> str:
+    """One hash over every analyzer source file (config included)."""
+    h = hashlib.sha256()
+    lint_dir = Path(__file__).resolve().parent
+    for p in sorted(lint_dir.rglob("*.py")):
+        h.update(p.relative_to(lint_dir).as_posix().encode())
+        h.update(b"\0")
+        h.update(p.read_bytes())
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def _graphs_from_files(
+    files: List[SourceFile],
+) -> Tuple[Dict[str, Set[str]], Dict[str, Set[str]]]:
+    """(forward, reverse) import graphs from already-parsed sources — no
+    second ast.parse over the corpus."""
+    known = {sf.rel for sf in files}
+    forward: Dict[str, Set[str]] = {}
+    reverse: Dict[str, Set[str]] = {}
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for dep in _imports_of(sf.rel, sf.tree, known):
+            if dep != sf.rel:
+                forward.setdefault(sf.rel, set()).add(dep)
+                reverse.setdefault(dep, set()).add(sf.rel)
+    return forward, reverse
+
+
+def _bidirectional_closure(
+    changed: Set[str],
+    forward: Dict[str, Set[str]],
+    reverse: Dict[str, Set[str]],
+) -> Set[str]:
+    seen = set(changed)
+    for edges in (reverse, forward):
+        work = list(seen)
+        while work:
+            cur = work.pop()
+            for nxt in edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    work.append(nxt)
+    return seen
+
+
+def _entry(findings: List[Finding], used, rel: str) -> dict:
+    return {
+        "findings": [
+            {k: getattr(f, k) for k in _FINDING_KEYS}
+            for f in findings
+            if f.path == rel and f.rule in CACHED_RULES
+        ],
+        "used_suppressions": sorted(
+            [line, rule_id]
+            for (r, line, rule_id) in used
+            if r == rel
+        ),
+    }
+
+
+def _write_manifest(path: Path, ruleset: str, shas: Dict[str, str],
+                    entries: Dict[str, dict]) -> None:
+    payload = {
+        "version": CACHE_VERSION,
+        "ruleset": ruleset,
+        "files": {
+            rel: {"sha": shas[rel], **entries[rel]} for rel in sorted(shas)
+        },
+    }
+    path.write_text(json.dumps(payload) + "\n")
+
+
+def _full_rebuild(root: Path, cfg: Config, cache_path: Path,
+                  ruleset: str, shas: Dict[str, str]):
+    findings, files = core.run(None, root=root, config=cfg)
+    ctx = core.LAST_CONTEXT
+    used = ctx.used_suppressions if ctx is not None else set()
+    entries = {rel: _entry(findings, used, rel) for rel in shas}
+    _write_manifest(cache_path, ruleset, shas, entries)
+    stats = {"mode": "rebuild", "analyzed": len(files), "reused": 0}
+    return findings, files, stats
+
+
+def cached_run(root: Path, config: Optional[Config] = None,
+               cache_path: Optional[Path] = None):
+    """Full-corpus results, reusing cached per-file findings for files
+    whose content (and the analyzer's) is unchanged.  Returns
+    ``(findings, files, stats)`` with findings identical to a plain
+    ``run()`` over the default corpus."""
+    import time
+
+    from . import rules  # noqa: F401  (registration side effect)
+
+    cfg = config or Config()
+    cache_path = cache_path or root / CACHE_FILE
+    ruleset = ruleset_hash(root)
+
+    core.LAST_TIMINGS.clear()
+    t0 = time.perf_counter()
+    files = [load_file(p, root) for p in iter_py_files(DEFAULT_PATHS, root)]
+    core.LAST_TIMINGS["load"] = time.perf_counter() - t0
+    shas = {sf.rel: _sha(sf.source.encode()) for sf in files}
+
+    manifest = None
+    if cache_path.exists():
+        try:
+            manifest = json.loads(cache_path.read_text())
+        except (OSError, ValueError):
+            manifest = None
+    if (manifest is None
+            or manifest.get("version") != CACHE_VERSION
+            or manifest.get("ruleset") != ruleset
+            or set(manifest.get("files", ())) != set(shas)):
+        return _full_rebuild(root, cfg, cache_path, ruleset, shas)
+
+    cached = manifest["files"]
+    changed = {rel for rel, sha in shas.items() if cached[rel]["sha"] != sha}
+    forward, reverse = _graphs_from_files(files)
+    slice_rels = (_bidirectional_closure(changed, forward, reverse)
+                  if changed else set())
+
+    # Run A — slice families over the bidirectional closure.  The slice
+    # is a partial corpus by construction; project-level checks stay on
+    # (BGT012 reads its targets from disk, so it is slice-safe).
+    slice_files = [sf for sf in files if sf.rel in slice_rels]
+    ctx_a = Context(
+        root=root, files=slice_files,
+        config=dataclasses.replace(cfg, partial_corpus=True),
+    )
+    passes_a = [p for p in core.PASSES
+                if core._pass_label(p) in SLICE_PASS_MODULES]
+    findings_a = timed_passes(ctx_a, passes_a, core.LAST_TIMINGS)
+
+    # Run B — whole-corpus families, always fresh
+    ctx_b = Context(root=root, files=files, config=cfg)
+    passes_b = [p for p in core.PASSES
+                if core._pass_label(p) in FULL_PASS_MODULES]
+    findings_b = timed_passes(ctx_b, passes_b, core.LAST_TIMINGS)
+
+    merged: List[Finding] = []
+    used = set(ctx_a.used_suppressions) | set(ctx_b.used_suppressions)
+    for rel, ent in cached.items():
+        if rel in slice_rels:
+            continue
+        merged.extend(Finding(**fd) for fd in ent["findings"])
+        used.update((rel, line, rid)
+                    for line, rid in ent["used_suppressions"])
+    for f in findings_a:
+        if f.rule in CACHED_RULES:
+            if f.path in slice_rels:
+                merged.append(f)
+        else:
+            merged.append(f)  # BGT012-style: recomputed fully every run
+    merged.extend(findings_b)
+    apply_suppressions(merged, files)
+
+    # post passes (BGT005) see the merged corpus-wide picture
+    ctx_b.used_suppressions = used
+    extra: List[Finding] = []
+    t0 = time.perf_counter()
+    for p in core.POST_PASSES:
+        extra.extend(p(ctx_b, merged))
+    core.LAST_TIMINGS["post"] = time.perf_counter() - t0
+    apply_suppressions(extra, files)
+    merged.extend(extra)
+    merged.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    entries = {}
+    for rel in shas:
+        if rel in slice_rels:
+            entries[rel] = _entry(findings_a, ctx_a.used_suppressions, rel)
+        else:
+            ent = cached[rel]
+            entries[rel] = {
+                "findings": ent["findings"],
+                "used_suppressions": ent["used_suppressions"],
+            }
+    _write_manifest(cache_path, ruleset, shas, entries)
+
+    stats = {
+        "mode": "warm",
+        "analyzed": len(slice_rels),
+        "reused": len(files) - len(slice_rels),
+    }
+    return merged, files, stats

@@ -126,6 +126,58 @@ STAGING_FACTORY_NAMES = frozenset({
 })
 STAGING_FACTORY_ATTRS = frozenset({"new_buffer", "new_batch_buffer"})
 
+# -- jit cache-key hazards (BGT070) ------------------------------------------
+# Functions allowed to create jit callables per call: factories that bake a
+# program per (shape, config) and whose CALLERS memoize the result.  Name
+# prefixes cover the repo's make_*/build_*/init_* convention; the explicit
+# set covers one-off exceptions.  ``__init__``, ``@cached_property`` /
+# ``@lru_cache`` bodies, keyed memo-cache assignments
+# (``cache[key] = jax.jit(...)``) and lazy module singletons
+# (``global _fn; _fn = jax.jit(...)``) are exempted structurally.
+JIT_FACTORY_PREFIXES: Tuple[str, ...] = (
+    "make_", "build_", "init_", "_make_", "_build_",
+)
+JIT_FACTORY_ALLOW: frozenset = frozenset()
+
+# -- solo/batched twin map (BGT073) ------------------------------------------
+# Declared duplicated hot-path implementations between the solo GgrsRunner
+# and the batched/wave stack: ``("file::Qual.name", "file::Qual.name",
+# expect, note)``.  expect="sync": the pair must stay identical after AST
+# normalization (locals renamed, docstrings/phase labels stripped) — any
+# divergence is a finding.  expect="drift": documented divergence, carried
+# in LINT_twins.json as the work-list for the ROADMAP-5 unification; a
+# drift pair that CONVERGES is also a finding (promote it to sync).
+TWIN_MAP: Tuple[Tuple[str, str, str, str], ...] = (
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner.arm_compile_guard",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner.arm_compile_guard",
+     "sync", "compile-guard arming hook — kept bit-identical"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner.update",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner.tick",
+     "drift", "per-tick orchestration + phase wrapping"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner._report_mismatch",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner._report_mismatch",
+     "drift", "synctest mismatch forensics (batched adds the lobby index)"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner._flush_speculation",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner._speculate_idle_lanes",
+     "drift", "speculative-draft seam (solo drains, batched fills idle lanes)"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner._service_rollback",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner._do_loads",
+     "drift", "rollback servicing (solo per-request, batched fused wave)"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner._stage_packed_rows",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner._do_runs",
+     "drift", "packed input staging ahead of dispatch"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner.finish",
+     "bevy_ggrs_tpu/batch_runner.py::BatchedRunner.finish",
+     "drift", "end-of-run flush + session check drain"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner._note_dispatch_uploads",
+     "bevy_ggrs_tpu/ops/batch.py::BucketedWaveExecutor._note_uploads",
+     "sync", "host-upload census accounting"),
+    ("bevy_ggrs_tpu/runner.py::GgrsRunner._note_compile",
+     "bevy_ggrs_tpu/ops/batch.py::BucketedWaveExecutor._dispatch",
+     "drift", "program-compile accounting (first-dispatch timing)"),
+)
+TWINS_JSON = "LINT_twins.json"
+
 # -- determinism-hazard scopes -----------------------------------------------
 # step/sim code: the only places wall-clock reads, jitted debug callbacks
 # and frozen-world mutation are hazards *by construction* (session code
@@ -165,6 +217,12 @@ class Config:
     transfer_guard_files: Tuple[str, ...] = TRANSFER_GUARD_FILES
     staging_factory_names: frozenset = STAGING_FACTORY_NAMES
     staging_factory_attrs: frozenset = STAGING_FACTORY_ATTRS
+    jit_factory_prefixes: Tuple[str, ...] = JIT_FACTORY_PREFIXES
+    jit_factory_allow: frozenset = JIT_FACTORY_ALLOW
+    twin_map: Tuple[Tuple[str, str, str, str], ...] = TWIN_MAP
+    # repo-root-relative path the BGT073 duplication inventory is written
+    # to on full project runs; None disables the write (fixture runs)
+    twins_json: str = TWINS_JSON
     # True for `--changed` runs: the corpus is a changed-files slice, so
     # reverse (stale-entry) docs checks and the stale-suppression
     # meta-rule would false-positive on everything the slice omits

@@ -294,27 +294,61 @@ def write_baseline(path: Path, findings: List[Finding]) -> None:
     path.write_text(json.dumps({"version": 1, "findings": entries}, indent=2))
 
 
+# wall-seconds per pass family (module basename) from the most recent
+# run()/cached run in this process — the `--timings` / time-budget CLI
+# surface and what check.sh prints
+LAST_TIMINGS: Dict[str, float] = {}
+# the Context of the most recent run() — the result cache needs the
+# consumed seed-line sanctions (ctx.used_suppressions) a plain
+# (findings, files) return cannot carry
+LAST_CONTEXT: Optional[Context] = None
+
+
+def _pass_label(fn: Callable) -> str:
+    return fn.__module__.rsplit(".", 1)[-1]
+
+
+def timed_passes(ctx: Context, passes, timings: Dict[str, float]) -> List[Finding]:
+    """Run ``passes`` over ``ctx`` accumulating wall time per pass family."""
+    import time
+
+    out: List[Finding] = []
+    for p in passes:
+        t0 = time.perf_counter()
+        out.extend(p(ctx))
+        label = _pass_label(p)
+        timings[label] = timings.get(label, 0.0) + time.perf_counter() - t0
+    return out
+
+
 def run(paths=None, root: Optional[Path] = None, config=None) -> Tuple[List[Finding], List[SourceFile]]:
     """Run every registered pass over ``paths``; returns (findings, files)
     with line-level suppressions already applied (baseline is the CLI's
     job — library callers see everything)."""
+    import time
+
     # rule/pass modules register themselves on import
     from . import rules  # noqa: F401  (registration side effect)
     from .config import Config
 
+    global LAST_CONTEXT
     root = Path(root) if root is not None else _find_root()
     cfg = config or Config()
+    LAST_TIMINGS.clear()
+    t0 = time.perf_counter()
     files = [load_file(p, root) for p in iter_py_files(paths or DEFAULT_PATHS, root)]
+    LAST_TIMINGS["load"] = time.perf_counter() - t0
     ctx = Context(root=root, files=files, config=cfg)
-    findings: List[Finding] = []
-    for p in PASSES:
-        findings.extend(p(ctx))
+    LAST_CONTEXT = ctx
+    findings = timed_passes(ctx, PASSES, LAST_TIMINGS)
     apply_suppressions(findings, files)
     # post passes (the stale-suppression meta-rule) see the suppressed-
     # marked findings; their own findings are suppressible too
     extra: List[Finding] = []
+    t0 = time.perf_counter()
     for p in POST_PASSES:
         extra.extend(p(ctx, findings))
+    LAST_TIMINGS["post"] = time.perf_counter() - t0
     apply_suppressions(extra, files)
     findings.extend(extra)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -363,6 +397,25 @@ def main(argv=None) -> int:
         "--changed-base", metavar="REF", default="HEAD",
         help="git ref --changed diffs against (default: HEAD)",
     )
+    ap.add_argument(
+        "--cache", action="store_true",
+        help="reuse per-file results from .lint_cache.json for files whose "
+             "content hash (and the analyzer's own) is unchanged; "
+             "whole-corpus rules always run fresh",
+    )
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="print per-pass-family wall time after the run",
+    )
+    ap.add_argument(
+        "--time-budget", metavar="SECONDS", type=float, default=None,
+        help="warn when total lint wall time exceeds SECONDS (soft gate; "
+             "see --time-budget-hard)",
+    )
+    ap.add_argument(
+        "--time-budget-hard", action="store_true",
+        help="exit nonzero when --time-budget is exceeded",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -388,6 +441,14 @@ def main(argv=None) -> int:
         # checks or the stale-suppression meta-rule without false
         # positives; the full run in check.sh keeps those armed
         findings, _files = run(paths, config=Config(partial_corpus=True))
+    elif args.cache and not args.paths:
+        from .cache import cached_run
+
+        findings, _files, stats = cached_run(_find_root())
+        print(
+            f"lint: cache {stats['mode']}: {stats['analyzed']} file(s) "
+            f"analyzed, {stats['reused']} reused"
+        )
     else:
         findings, _files = run(args.paths or None)
 
@@ -425,8 +486,25 @@ def main(argv=None) -> int:
         else:
             Path(args.json).write_text(text + "\n")
 
+    total = sum(LAST_TIMINGS.values())
+    if args.timings:
+        for label, secs in sorted(
+                LAST_TIMINGS.items(), key=lambda kv: -kv[1]):
+            print(f"lint-timing: {label:16s} {secs * 1e3:8.1f} ms")
+        print(f"lint-timing: {'total':16s} {total * 1e3:8.1f} ms")
+
     print(
         f"lint: {len(RULES)} rules, {len(active)} findings "
         f"({len(errors)} errors, {len(suppressed)} suppressed)"
     )
-    return 1 if errors else 0
+    over_budget = args.time_budget is not None and total > args.time_budget
+    if over_budget:
+        print(
+            f"lint: WARNING: wall time {total:.2f}s exceeded the "
+            f"--time-budget of {args.time_budget:.2f}s"
+            + ("" if args.time_budget_hard else " (soft gate; use "
+               "--time-budget-hard to fail on this)")
+        )
+    if errors:
+        return 1
+    return 1 if (over_budget and args.time_budget_hard) else 0

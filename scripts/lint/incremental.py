@@ -107,9 +107,11 @@ def _imports_of(rel: str, tree: ast.AST, known: Set[str]) -> Set[str]:
     return out
 
 
-def build_reverse_import_graph(root: Path) -> Tuple[Set[str], Dict[str, Set[str]]]:
-    """``(corpus_rels, imported_rel -> {importer_rel, ...})`` over the
-    default lint corpus (fixtures excluded, same as a full run)."""
+def build_import_graphs(
+    root: Path,
+) -> Tuple[Set[str], Dict[str, Set[str]], Dict[str, Set[str]]]:
+    """``(corpus_rels, importer_rel -> deps, imported_rel -> importers)``
+    over the default lint corpus (fixtures excluded, same as a full run)."""
     files = iter_py_files(DEFAULT_PATHS, root)
     rels: List[Tuple[str, Path]] = []
     for p in files:
@@ -119,6 +121,7 @@ def build_reverse_import_graph(root: Path) -> Tuple[Set[str], Dict[str, Set[str]
             rel = p.as_posix()
         rels.append((rel, p))
     known = {rel for rel, _ in rels}
+    forward: Dict[str, Set[str]] = {}
     reverse: Dict[str, Set[str]] = {}
     for rel, p in rels:
         try:
@@ -127,26 +130,55 @@ def build_reverse_import_graph(root: Path) -> Tuple[Set[str], Dict[str, Set[str]
             continue
         for dep in _imports_of(rel, tree, known):
             if dep != rel:
+                forward.setdefault(rel, set()).add(dep)
                 reverse.setdefault(dep, set()).add(rel)
+    return known, forward, reverse
+
+
+def build_reverse_import_graph(root: Path) -> Tuple[Set[str], Dict[str, Set[str]]]:
+    """``(corpus_rels, imported_rel -> {importer_rel, ...})`` over the
+    default lint corpus (fixtures excluded, same as a full run)."""
+    known, _forward, reverse = build_import_graphs(root)
     return known, reverse
+
+
+def _closure(seed: Set[str], edges: Dict[str, Set[str]],
+             seen: Set[str]) -> None:
+    work = list(seed)
+    while work:
+        cur = work.pop()
+        for nxt in edges.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                work.append(nxt)
 
 
 def expand_dependents(changed: Iterable[str], root: Path) -> List[str]:
     """The changed .py files that exist in the lint corpus, plus every
     transitive importer — sorted repo-relative paths."""
-    known, reverse = build_reverse_import_graph(root)
-    work = [c for c in changed if c.endswith(".py") and c in known]
-    seen: Set[str] = set(work)
-    while work:
-        cur = work.pop()
-        for importer in reverse.get(cur, ()):
-            if importer not in seen:
-                seen.add(importer)
-                work.append(importer)
+    known, _forward, reverse = build_import_graphs(root)
+    seed = {c for c in changed if c.endswith(".py") and c in known}
+    seen = set(seed)
+    _closure(seed, reverse, seen)
+    return sorted(seen)
+
+
+def expand_closure(changed: Iterable[str], root: Path,
+                   graphs=None) -> List[str]:
+    """Bidirectional slice: changed files, every transitive importer, and
+    every transitive forward import of all of those.  The forward half is
+    what interprocedural chain rules (BGT011/BGT063/BGT071) need when the
+    *caller* changed: its witness chains resolve through callee modules
+    the reverse closure alone would omit."""
+    known, forward, reverse = graphs or build_import_graphs(root)
+    seed = {c for c in changed if c.endswith(".py") and c in known}
+    seen = set(seed)
+    _closure(seed, reverse, seen)
+    _closure(set(seen), forward, seen)
     return sorted(seen)
 
 
 def changed_corpus(root: Path, base: str = "HEAD") -> Tuple[List[str], Set[str]]:
     """``(paths_to_lint, raw_changed_set)`` for the --changed CLI mode."""
     changed = git_changed_files(root, base=base)
-    return expand_dependents(changed, root), changed
+    return expand_closure(changed, root), changed

@@ -12,6 +12,8 @@ catalog all key on them):
 - ``BGT04x`` determinism hazards in step/model/session code
 - ``BGT05x`` rule-id <-> docs-catalog cross-check
 - ``BGT06x`` concurrency & transfer races in the control plane
+- ``BGT07x`` recompilation & engine-drift: jit cache-key hazards,
+  data-dependent shapes, dtype-promotion drift, solo/batched twin drift
 """
 
 from . import imports  # noqa: F401
@@ -25,3 +27,7 @@ from . import shared_state  # noqa: F401
 from . import locks  # noqa: F401
 from . import lock_order  # noqa: F401
 from . import transfer_race  # noqa: F401
+from . import jit_cache  # noqa: F401
+from . import shape_stability  # noqa: F401
+from . import dtype_drift  # noqa: F401
+from . import twin_drift  # noqa: F401

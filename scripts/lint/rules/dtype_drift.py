@@ -1,0 +1,169 @@
+"""Dtype-promotion drift in sim arithmetic — BGT072.
+
+A world component's dtype is part of the persisted contract: the
+checkpoint schema digest (``snapshot/persist.py``) records
+``path:dtype:shape`` per leaf, and ``load_world`` fails LOUDLY on any
+leaf whose stored dtype differs from the live registry.  JAX's weak-type
+promotion makes that failure trivially easy to manufacture: one bare
+Python float literal (``pos * 0.5``) or one true division in arithmetic
+on an int-declared component silently promotes the array to float — the
+next ``save_world``/``load_world`` round-trip then dies on the exact
+schema-digest mismatch this rule's finding predicts.
+
+The check is file-local by design: each model module declares its own
+components (``app.rollback_component("pos", (2,), jnp.int32)``), so the
+name -> dtype-category map never crosses files and a ``pos`` that is
+int32 in ``fixed_point.py`` but float32 in ``crowd.py`` cannot
+cross-contaminate.  Only int-category components are hazardous — float
+components absorb Python float literals without changing dtype.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+
+rule(
+    "BGT072", "dtype-promotion-drift",
+    summary="float promotion of an int-declared component — the persisted "
+            "schema digest (persist.py load_world) will fail on it",
+)
+
+_INT_DTYPES = frozenset({
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_",
+})
+_FLOAT_DTYPES = frozenset({"float16", "float32", "float64", "bfloat16"})
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Mod, ast.Pow, ast.FloorDiv)
+
+
+def _dtype_category(node: ast.AST) -> Optional[str]:
+    """'int' / 'float' for a ``jnp.int32``-style dtype expression."""
+    name = None
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    if name in _INT_DTYPES:
+        return "int"
+    if name in _FLOAT_DTYPES:
+        return "float"
+    return None
+
+
+def _component_kinds(tree: ast.AST) -> Dict[str, str]:
+    """name -> dtype category from this module's rollback_component
+    declarations (conflicting redeclarations drop the name)."""
+    kinds: Dict[str, str] = {}
+    dropped: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "rollback_component"
+                and len(node.args) >= 3
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        cat = _dtype_category(node.args[2])
+        if cat is None:
+            continue
+        name = node.args[0].value
+        if name in kinds and kinds[name] != cat:
+            dropped.add(name)
+        kinds[name] = cat
+    for name in dropped:
+        kinds.pop(name, None)
+    return kinds
+
+
+def _comp_name(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """The component name when ``node`` reads an int component: either
+    ``<x>.comps["name"]`` directly or a local alias bound from one."""
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "comps"
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)):
+        return node.slice.value
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    return None
+
+
+def check_dtype_drift(sf: SourceFile, kinds: Dict[str, str]) -> List[Finding]:
+    out: List[Finding] = []
+    int_comps = {n for n, c in kinds.items() if c == "int"}
+    if not int_comps:
+        return out
+
+    for fn in (n for n in ast.walk(sf.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        # local aliases: vel = world.comps["vel"]  (tuple unpacks too)
+        aliases: Dict[str, str] = {}
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Assign):
+                continue
+            for t in n.targets:
+                pairs = []
+                if isinstance(t, ast.Name):
+                    pairs = [(t, n.value)]
+                elif isinstance(t, ast.Tuple) and isinstance(n.value, ast.Tuple) \
+                        and len(t.elts) == len(n.value.elts):
+                    pairs = list(zip(t.elts, n.value.elts))
+                for tgt, val in pairs:
+                    if isinstance(tgt, ast.Name):
+                        name = _comp_name(val, {})
+                        if name in int_comps:
+                            aliases[tgt.id] = name
+
+        def int_side(expr) -> Optional[str]:
+            name = _comp_name(expr, aliases)
+            return name if name in int_comps else None
+
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.BinOp):
+                continue
+            name = int_side(n.left) or int_side(n.right)
+            if name is None:
+                continue
+            if isinstance(n.op, ast.Div):
+                out.append(Finding(
+                    "BGT072", sf.rel, n.lineno,
+                    f"true division of int component {name!r} promotes it "
+                    "to float — the stored dtype drifts from its "
+                    "rollback_component declaration and load_world's "
+                    "schema-digest check (snapshot/persist.py) fails the "
+                    "next checkpoint round-trip; use // or astype first",
+                ))
+                continue
+            if isinstance(n.op, _ARITH_OPS):
+                other = n.right if int_side(n.left) else n.left
+                if isinstance(other, ast.Constant) and isinstance(
+                        other.value, float):
+                    out.append(Finding(
+                        "BGT072", sf.rel, n.lineno,
+                        f"bare float literal {other.value!r} in arithmetic "
+                        f"on int component {name!r} weak-type-promotes the "
+                        "result to float — the stored dtype drifts from "
+                        "its rollback_component declaration and "
+                        "load_world's schema-digest check "
+                        "(snapshot/persist.py) fails the next checkpoint "
+                        "round-trip; use an int literal or astype "
+                        "explicitly",
+                    ))
+    return out
+
+
+@lint_pass
+def dtype_drift_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test or not cfg.in_sim_code(sf.rel):
+            continue
+        kinds = _component_kinds(sf.tree)
+        if kinds:
+            out.extend(check_dtype_drift(sf, kinds))
+    return out

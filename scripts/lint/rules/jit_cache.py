@@ -1,0 +1,262 @@
+"""jit cache-key hazards — BGT070.
+
+Every hot-path guarantee the engine ships assumes XLA executables stay
+*cached*: ``jax.jit`` keys its cache on the callable's identity plus the
+static-argument values, so three Python-side patterns silently defeat it
+and turn a 60Hz tick into a 10-50ms compile cliff:
+
+- **fresh callable per call** — ``jax.jit(f)`` (or a lambda / local def /
+  inline ``functools.partial``) created inside a function that runs per
+  tick builds a NEW cache every call; nothing ever hits.  Sanctioned
+  creation sites: module scope, ``make_*``/``build_*``/``init_*``
+  factories (callers memoize the result), ``__init__`` bodies,
+  ``@cached_property``/``@lru_cache`` bodies, keyed memo caches
+  (``cache[key] = jax.jit(...)``) and lazy module singletons
+  (``global _fn; _fn = jax.jit(...)``).
+- **per-call-varying / non-literal static args** — a ``static_argnums``
+  or ``static_argnames`` value that is not a literal cannot be proven
+  call-stable; every distinct runtime value is a separate executable.
+  Likewise an f-string, dict or other non-hashable literal fed through a
+  ``functools.partial`` into ``jax.jit`` either crashes hashing or keys
+  the cache on object identity (fresh per call).
+- **mutable closed-over state** — a jitted local function that closes
+  over a name the enclosing scope mutates (``state[k] = ...``,
+  ``xs.append(...)``, augmented assignment) bakes the value at trace
+  time: the mutation is invisible to later cached calls, a silent
+  determinism drift no recompile ever fixes.
+
+The runtime twin is the ``BGT_COMPILE_GUARD`` sentinel
+(``bevy_ggrs_tpu/utils/compile_guard.py``): what this rule cannot prove
+statically trips :class:`RecompileError` on the first steady-state
+compile.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+from .determinism import _alias_map, _dotted_path
+
+rule(
+    "BGT070", "jit-cache-key-hazard",
+    summary="jit cache-key hazard: fresh callable, non-literal static args "
+            "or mutable closed-over state",
+)
+
+_JIT_PATHS = frozenset({"jax.jit", "jax.experimental.jit"})
+_PARTIAL_PATHS = frozenset({"functools.partial", "partial"})
+# decorators whose body runs (at most) once per instance/process
+_CACHING_DECOS = frozenset({
+    "cached_property", "functools.cached_property", "property",
+    "lru_cache", "functools.lru_cache", "cache", "functools.cache",
+})
+_MUTATOR_ATTRS = frozenset({
+    "append", "extend", "add", "update", "pop", "popitem", "remove",
+    "discard", "clear", "insert", "setdefault",
+})
+
+
+def _is_literal_static(node: ast.AST) -> bool:
+    """True for static_argnums/static_argnames values jit can key stably
+    AND whose value provably never varies between calls: int/str literals
+    or tuples/lists of them."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str))
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal_static(e) for e in node.elts)
+    return False
+
+
+def _decorator_paths(fn: ast.AST, aliases: Dict[str, str]) -> Set[str]:
+    out: Set[str] = set()
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _dotted_path(target, aliases)
+        if dotted:
+            out.add(dotted)
+    return out
+
+
+class _Scope:
+    """One enclosing function: name, exemption status, mutated names."""
+
+    def __init__(self, fn, aliases: Dict[str, str], cfg):
+        self.fn = fn
+        self.name = fn.name
+        self.globals: Set[str] = {
+            g for n in ast.walk(fn) if isinstance(n, (ast.Global,))
+            for g in n.names
+        }
+        decos = _decorator_paths(fn, aliases)
+        self.exempt = (
+            fn.name == "__init__"
+            or fn.name in cfg.jit_factory_allow
+            or any(fn.name.startswith(p) for p in cfg.jit_factory_prefixes)
+            or bool(decos & _CACHING_DECOS)
+        )
+        # names the function mutates in place (closure hazard targets)
+        self.mutated: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
+                self.mutated.add(n.target.id)
+            elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name):
+                        self.mutated.add(t.value.id)
+            elif (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _MUTATOR_ATTRS
+                    and isinstance(n.func.value, ast.Name)):
+                self.mutated.add(n.func.value.id)
+        # local function defs (closure-hazard candidates for jit(Name))
+        self.local_defs: Dict[str, ast.AST] = {
+            n.name: n for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+
+
+def _free_names(fn: ast.AST) -> Set[str]:
+    """Names a function loads but never binds — its closure surface."""
+    bound: Set[str] = {a.arg for a in fn.args.args}
+    bound.update(a.arg for a in fn.args.kwonlyargs)
+    bound.update(a.arg for a in fn.args.posonlyargs)
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    loads: Set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+            else:
+                loads.add(n.id)
+    return loads - bound
+
+
+def _bad_partial_arg(call: ast.Call) -> Optional[str]:
+    """A non-hashable / per-call-unstable argument inside a partial(...)
+    feeding jit, or None."""
+    for a in list(call.args) + [k.value for k in call.keywords]:
+        if isinstance(a, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+            return "a mutable container literal"
+        if isinstance(a, ast.JoinedStr):
+            return "an f-string"
+    return None
+
+
+def check_jit_cache(sf: SourceFile, cfg) -> List[Finding]:
+    out: List[Finding] = []
+    aliases = _alias_map(sf.tree)
+
+    # innermost enclosing _Scope per node, plus Assign context per call
+    scopes: Dict[int, Optional[_Scope]] = {}
+    assign_of: Dict[int, ast.Assign] = {}
+
+    def walk(node, scope):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope = _Scope(node, aliases, cfg)
+        scopes[id(node)] = scope
+        if isinstance(node, ast.Assign):
+            v = node.value
+            if isinstance(v, ast.Call):
+                assign_of[id(v)] = node
+        for child in ast.iter_child_nodes(node):
+            walk(child, scope)
+
+    walk(sf.tree, None)
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted_path(node.func, aliases)
+        if dotted not in _JIT_PATHS:
+            continue
+        scope = scopes.get(id(node))
+        line = node.lineno
+
+        # closure over mutated state: hazard regardless of creation site
+        target = node.args[0] if node.args else None
+        if (scope is not None and isinstance(target, ast.Name)
+                and target.id in scope.local_defs):
+            shared = _free_names(scope.local_defs[target.id]) & scope.mutated
+            if shared:
+                names = ", ".join(sorted(shared))
+                out.append(Finding(
+                    "BGT070", sf.rel, line,
+                    f"jitted function {target.id!r} closes over {names} "
+                    f"which {scope.name}() mutates in place — the traced "
+                    "value is baked at compile time, so the mutation is "
+                    "invisible to every later cached call (silent drift); "
+                    "pass the state as an argument instead",
+                ))
+                continue
+
+        if scope is None or scope.exempt:
+            continue  # module scope / factory / memoized one-shot
+
+        # non-literal static args: every distinct runtime value is a
+        # separate executable — report the most specific hazard only
+        bad_static = next(
+            (k.arg for k in node.keywords
+             if k.arg in ("static_argnums", "static_argnames")
+             and not _is_literal_static(k.value)), None)
+        if bad_static is not None:
+            out.append(Finding(
+                "BGT070", sf.rel, line,
+                f"jit inside {scope.name}() with a non-literal "
+                f"{bad_static} — the static value cannot be proven "
+                "call-stable, so every distinct value recompiles; hoist "
+                "the jit to a memoized factory keyed on the static value",
+            ))
+            continue
+        bad_part = None
+        if isinstance(target, ast.Call):
+            tp = _dotted_path(target.func, aliases)
+            if tp in _PARTIAL_PATHS:
+                bad_part = _bad_partial_arg(target)
+        if bad_part is not None:
+            out.append(Finding(
+                "BGT070", sf.rel, line,
+                f"jit of a functools.partial carrying {bad_part} inside "
+                f"{scope.name}() — the partial is rebuilt per call and "
+                "its arguments defeat (or crash) the jit cache key; bake "
+                "the value into a module-level program or a keyed factory",
+            ))
+            continue
+
+        # memoized creation sites are sanctioned: cache[key] = jax.jit(...)
+        # and the lazy `global _fn` singleton
+        assign = assign_of.get(id(node))
+        if assign is not None:
+            if any(isinstance(t, ast.Subscript) for t in assign.targets):
+                continue
+            if any(isinstance(t, ast.Name) and t.id in scope.globals
+                   for t in assign.targets):
+                continue
+        out.append(Finding(
+            "BGT070", sf.rel, line,
+            f"jit callable created inside {scope.name}() — a fresh jit "
+            "misses the executable cache on every call (compile cliff "
+            "mid-tick; the BGT_COMPILE_GUARD runtime twin raises "
+            "RecompileError here); hoist to module scope, a "
+            "make_*/build_* factory, or a keyed memo cache",
+        ))
+    return out
+
+
+@lint_pass
+def jit_cache_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        out.extend(check_jit_cache(sf, cfg))
+    return out

@@ -485,8 +485,11 @@ def purity_pass(ctx: Context) -> List[Finding]:
             out.append(Finding("BGT010", sf.rel, line, msg))
 
     # BGT011 — interprocedural: package call graph, report call sites in
-    # hot files whose resolved callee transitively forces
+    # hot files whose resolved callee transitively forces.  The graph is
+    # stashed on ctx so later passes (BGT071 witness chains) reuse the
+    # module/call-edge resolution instead of rebuilding it.
     graph = CallGraph(ctx)
+    ctx._callgraph = graph
     for sf, allow in hot_files:
         mod = graph.by_rel.get(sf.rel)
         if mod is None:

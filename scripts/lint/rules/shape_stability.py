@@ -1,0 +1,280 @@
+"""Data-dependent-shape ops in sim/rollback scope — BGT071.
+
+Under ``jax.jit`` an array's *shape* is part of the program: an op whose
+output shape depends on array *values* (``nonzero``, boolean-mask
+indexing, ``concatenate`` over a dynamically-sized sequence, ``reshape``
+to a data-derived size) either fails to trace or — when it sneaks through
+host-side — recompiles the program once per distinct shape, a 10-50ms
+cliff per tick that defeats every cached-program guarantee the engine
+ships.  Inside sim/rollback scope (``models/``, ``ops/``) these ops are
+hazards *by construction*; fixed-capacity masks (``jnp.where(mask, x,
+y)``) are the sanctioned alternative.
+
+Like the hot-loop purity rule (BGT011), the check is interprocedural:
+a sim-scope function that *reaches* a data-dependent-shape op through
+the package call graph is flagged at its call site with the full witness
+chain, and a ``# bgt: ignore[BGT071]: reason`` on the direct (seed) line
+sanctions every caller at once.  The runtime twin is the
+``BGT_COMPILE_GUARD`` sentinel, which catches the recompiles this rule
+cannot prove statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Context, Finding, SourceFile, lint_pass, rule
+from .purity import CallGraph, FuncKey
+
+rule(
+    "BGT071", "data-dependent-shape",
+    summary="data-dependent-shape op in (or reachable from) sim/rollback "
+            "scope — shapes must be value-independent under jit",
+)
+
+# calls whose RESULT shape depends on array values
+_SHAPE_CALL_ATTRS = frozenset({
+    "nonzero", "flatnonzero", "argwhere", "compress", "extract",
+})
+# jnp.unique is value-dependent unless given a static `size=`
+_UNIQUE_ATTRS = frozenset({"unique"})
+# calls producing boolean masks (subscripting with one is a gather of
+# data-dependent length)
+_MASK_CALLS = frozenset({
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "isnan", "isfinite", "isinf", "isclose", "equal", "not_equal",
+    "greater", "greater_equal", "less", "less_equal",
+})
+# attribute calls that taint a reshape size as data-derived
+_SIZE_TAINT_ATTRS = frozenset({"sum", "item", "count_nonzero"})
+_CONCAT_NAMES = frozenset({
+    "concatenate", "stack", "hstack", "vstack", "column_stack",
+})
+
+
+def _call_attr(node: ast.Call) -> Optional[str]:
+    """Trailing attribute/name of a call target (``jnp.nonzero`` ->
+    ``nonzero``, ``x.reshape`` -> ``reshape``)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_mask_expr(node: ast.AST, mask_names: set) -> bool:
+    if isinstance(node, (ast.Compare, ast.BoolOp)):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.Invert, ast.Not)):
+        return _is_mask_expr(node.operand, mask_names)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.BitAnd, ast.BitOr)):
+        return (_is_mask_expr(node.left, mask_names)
+                or _is_mask_expr(node.right, mask_names))
+    if isinstance(node, ast.Name):
+        return node.id in mask_names
+    if isinstance(node, ast.Call):
+        a = _call_attr(node)
+        return a in _MASK_CALLS
+    return False
+
+
+def _size_is_data_derived(node: ast.AST) -> bool:
+    """True when a reshape size expression contains a value read
+    (``int(x.sum())``, ``mask.sum()``, ``n.item()``)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            a = _call_attr(n)
+            if a in _SIZE_TAINT_ATTRS:
+                return True
+            if isinstance(n.func, ast.Name) and n.func.id == "int" and n.args:
+                if any(isinstance(x, (ast.Call, ast.Subscript, ast.Attribute))
+                       for x in ast.walk(n.args[0])):
+                    return True
+    return False
+
+
+def _has_static_size_kw(node: ast.Call) -> bool:
+    return any(k.arg == "size" for k in node.keywords)
+
+
+def scan_shape_hazards(sf: SourceFile) -> List[Tuple[str, int, str]]:
+    """``(qualname, line, description)`` for every data-dependent-shape
+    op in the file, attributed to the innermost enclosing function
+    (qualnames match the purity call graph's collector)."""
+    out: List[Tuple[str, int, str]] = []
+
+    def visit_fn(fn: ast.AST, qual: str) -> None:
+        mask_names = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and isinstance(n.value, (
+                    ast.Compare, ast.BoolOp)):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        mask_names.add(t.id)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                a = _call_attr(n)
+                if a in _SHAPE_CALL_ATTRS:
+                    out.append((qual, n.lineno,
+                                f"{a}() has a data-dependent result shape"))
+                elif a in _UNIQUE_ATTRS and not _has_static_size_kw(n):
+                    out.append((qual, n.lineno,
+                                "unique() without a static size= has a "
+                                "data-dependent result shape"))
+                elif a == "where" and len(n.args) == 1 and not n.keywords:
+                    out.append((qual, n.lineno,
+                                "single-argument where() returns "
+                                "data-dependent index arrays"))
+                elif a == "reshape":
+                    sizes = n.args
+                    if len(sizes) == 1 and isinstance(sizes[0], (ast.Tuple,
+                                                                 ast.List)):
+                        sizes = sizes[0].elts
+                    if any(_size_is_data_derived(s) for s in sizes):
+                        out.append((qual, n.lineno,
+                                    "reshape to a data-derived size"))
+                elif a in _CONCAT_NAMES and n.args:
+                    seq = n.args[0]
+                    if isinstance(seq, (ast.Name, ast.GeneratorExp,
+                                        ast.ListComp, ast.Starred)):
+                        out.append((qual, n.lineno,
+                                    f"{a}() over a dynamically-sized "
+                                    "sequence — result length varies per "
+                                    "call"))
+            elif isinstance(n, ast.Subscript) and not isinstance(
+                    n.ctx, ast.Store):
+                sl = n.slice
+                if _is_mask_expr(sl, mask_names):
+                    out.append((qual, n.lineno,
+                                "boolean-mask indexing selects a "
+                                "data-dependent number of rows"))
+
+    def walk(node, stack: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + (child.name,))
+                visit_fn(child, qual)
+                walk(child, stack + (child.name,))
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + (child.name,))
+            else:
+                walk(child, stack)
+
+    walk(sf.tree, ())
+    # hazards inside nested defs are attributed to BOTH quals by the
+    # double-walk above; dedupe on (line, desc) keeping the innermost
+    seen = {}
+    for qual, line, desc in out:
+        cur = seen.get((line, desc))
+        if cur is None or len(qual) > len(cur):
+            seen[(line, desc)] = qual
+    return [(q, line, desc) for (line, desc), q in sorted(
+        seen.items(), key=lambda kv: kv[0][0])]
+
+
+@lint_pass
+def shape_stability_pass(ctx: Context) -> List[Finding]:
+    cfg = ctx.config
+    out: List[Finding] = []
+
+    # 1. direct scan: findings in sim scope, seeds everywhere
+    seeds: Dict[FuncKey, Tuple[int, str]] = {}
+    by_rel_hazards: Dict[str, List] = {}
+    for sf in ctx.files:
+        if sf.tree is None or sf.is_test:
+            continue
+        hazards = scan_shape_hazards(sf)
+        if not hazards:
+            continue
+        by_rel_hazards[sf.rel] = hazards
+        in_sim = cfg.in_sim_code(sf.rel)
+        for qual, line, desc in hazards:
+            sup = sf.suppressions.get(line, {})
+            sanctioned = "BGT071" in sup
+            if sanctioned:
+                # seed-line sanction: the suppression stops propagation
+                # to every caller, so it is live even when no finding
+                # lands on the line itself (non-sim seed files)
+                ctx.used_suppressions.add((sf.rel, line, "BGT071"))
+            else:
+                seeds[(sf.rel, qual)] = (line, desc)
+            if in_sim:
+                # sanctioned sim-scope hazards still emit — core marks
+                # them suppressed, same contract as every other rule
+                out.append(Finding(
+                    "BGT071", sf.rel, line,
+                    f"{desc} — inside sim/rollback scope shapes must be "
+                    "value-independent under jit (fixed-capacity "
+                    "jnp.where masks are the sanctioned form); every "
+                    "distinct shape is a steady-state recompile the "
+                    "BGT_COMPILE_GUARD sentinel would trip on",
+                ))
+
+    if not seeds:
+        return out
+
+    # 2. witness chains: sim-scope call sites reaching a non-sim seed
+    graph = getattr(ctx, "_callgraph", None)
+    if graph is None:
+        graph = CallGraph(ctx)
+        ctx._callgraph = graph
+
+    # why[key] = ("seed", line, desc) | ("via", line, next_key)
+    why: Dict[FuncKey, tuple] = {}
+    edges_rev: Dict[FuncKey, List] = {}
+    for key, res in graph.resolved.items():
+        for line, tgt in res:
+            edges_rev.setdefault(tgt.key, []).append((key, line))
+    work = []
+    for key, (line, desc) in seeds.items():
+        if key in graph.funcs:
+            why[key] = ("seed", line, desc)
+            work.append(key)
+    while work:
+        key = work.pop()
+        for caller_key, line in edges_rev.get(key, []):
+            if caller_key not in why:
+                why[caller_key] = ("via", line, key)
+                work.append(caller_key)
+
+    def chain(key: FuncKey) -> str:
+        hops = []
+        cur = key
+        for _ in range(32):
+            w = why.get(cur)
+            if w is None:
+                break
+            if w[0] == "seed":
+                hops.append(f"{cur[1]}() [{cur[0]}:{w[1]}] — {w[2]}")
+                break
+            hops.append(f"{cur[1]}() [{cur[0]}:{w[1]}]")
+            cur = w[2]
+        return " -> ".join(hops)
+
+    for rel, mod in graph.by_rel.items():
+        if not cfg.in_sim_code(rel):
+            continue
+        for fn in mod.funcs.values():
+            for line, tgt in graph.resolved.get(fn.key, []):
+                if tgt.key not in why:
+                    continue
+                # seeds in sim files already carry a direct finding at
+                # the hazard line; chain findings cover the cross-file
+                # case where the seed sits outside sim scope
+                seed_key = tgt.key
+                w = why[seed_key]
+                while w[0] == "via":
+                    seed_key = w[2]
+                    w = why[seed_key]
+                if cfg.in_sim_code(seed_key[0]):
+                    continue
+                out.append(Finding(
+                    "BGT071", rel, line,
+                    f"{fn.key[1]}() reaches a data-dependent-shape op: "
+                    f"{chain(tgt.key)} — shapes must be value-independent "
+                    "in sim/rollback scope; suppress at the seed line if "
+                    "the shape set is provably bounded",
+                ))
+    return out

@@ -1,0 +1,36 @@
+"""BGT070 clean: every sanctioned jit creation site."""
+import jax
+
+
+def _impl(x, axis):
+    return x.sum(axis)
+
+
+_step = jax.jit(_impl)  # module scope
+
+_cache = {}
+_fn = None
+
+
+def make_step(axis):
+    # factory prefix: callers memoize the result
+    return jax.jit(lambda x: x.sum(axis))
+
+
+def step_for(k):
+    fn = _cache.get(k)
+    if fn is None:
+        fn = _cache[k] = jax.jit(lambda x: x + k)  # keyed memo cache
+    return fn
+
+
+def get_step():
+    global _fn
+    if _fn is None:
+        _fn = jax.jit(_impl)  # lazy module singleton
+    return _fn
+
+
+class Runner:
+    def __init__(self):
+        self.fn = jax.jit(_impl)  # once per instance

@@ -1,0 +1,15 @@
+"""A toy runner with a per-call-varying static argument: the exact site
+BGT070 flags statically AND the armed compile guard trips at runtime
+(tests/test_compile_guard.py drives both halves)."""
+import jax
+
+_STATIC_ARGS = (1,)
+
+
+def _impl(x, scale):
+    return x * scale
+
+
+def tick(x, scale):
+    fn = jax.jit(_impl, static_argnums=_STATIC_ARGS)
+    return fn(x, scale)

@@ -1,0 +1,34 @@
+"""BGT070 true positives — one function per jit cache-key hazard shape."""
+import functools
+
+import jax
+
+
+def _impl(x, axis):
+    return x.sum(axis)
+
+
+def tick_fresh(x):
+    fn = jax.jit(_impl)  # fresh callable per call: nothing ever hits
+    return fn(x, 0)
+
+
+def tick_static(x, axes):
+    fn = jax.jit(_impl, static_argnums=axes)  # non-literal static args
+    return fn(x, 0)
+
+
+def tick_partial(x, n):
+    fn = jax.jit(functools.partial(_impl, opts={"n": n}))  # dict via partial
+    return fn(x)
+
+
+def tick_closure(xs):
+    state = []
+
+    def body(x):
+        return x + len(state)
+
+    fn = jax.jit(body)  # closes over `state`, which this scope mutates
+    state.append(1)
+    return fn(xs)

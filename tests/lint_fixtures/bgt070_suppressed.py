@@ -1,0 +1,11 @@
+"""BGT070 with a justified line suppression."""
+import jax
+
+
+def _impl(x, axis):
+    return x.sum(axis)
+
+
+def probe(x):
+    fn = jax.jit(_impl)  # bgt: ignore[BGT070]: one-shot diagnostic probe — rebuilding the program per run is the point
+    return fn(x, 0)

@@ -1,0 +1,19 @@
+"""BGT071 clean: fixed-capacity forms of every flagged op."""
+import jax.numpy as jnp
+
+
+def masked_damage(w):
+    mask = w.hp > 0
+    return jnp.sum(jnp.where(mask, w.dmg, 0))
+
+
+def top_teams(w):
+    return jnp.unique(w.team, size=8, fill_value=-1)
+
+
+def to_grid(x):
+    return x.reshape(4, -1)
+
+
+def pair_rows(a, b):
+    return jnp.stack([a, b])

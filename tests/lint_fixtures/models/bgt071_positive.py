@@ -1,0 +1,23 @@
+"""BGT071 true positives — data-dependent result shapes in sim scope."""
+import jax.numpy as jnp
+
+
+def live_indices(w):
+    return jnp.nonzero(w.alive)
+
+
+def gather_alive(w):
+    mask = w.hp > 0
+    return w.pos[mask]
+
+
+def unique_teams(w):
+    return jnp.unique(w.team)
+
+
+def hit_coords(w):
+    return jnp.where(w.hits)
+
+
+def merge_rows(rows):
+    return jnp.concatenate(rows)

@@ -1,0 +1,6 @@
+"""BGT071 with a justified seed-line suppression."""
+import jax.numpy as jnp
+
+
+def checksum_lanes(parts):
+    return jnp.concatenate(parts)  # bgt: ignore[BGT071]: lane count is fixed by the registry at startup, never data-dependent

@@ -1,0 +1,15 @@
+"""BGT072 clean: int-preserving and explicitly-cast arithmetic."""
+import jax.numpy as jnp
+
+
+def register(app):
+    app.rollback_component("ammo", (1,), jnp.int32)
+    app.rollback_component("heat", (1,), jnp.float32)
+
+
+def step(world):
+    ammo = world.comps["ammo"]
+    halved = ammo // 2
+    scaled = ammo.astype(jnp.float32) * 0.5
+    heat = world.comps["heat"] * 0.9
+    return halved, scaled, heat

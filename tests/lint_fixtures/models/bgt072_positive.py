@@ -1,0 +1,14 @@
+"""BGT072 true positives — float promotion of int-declared components."""
+import jax.numpy as jnp
+
+
+def register(app):
+    app.rollback_component("ammo", (1,), jnp.int32)
+    app.rollback_component("heat", (1,), jnp.float32)
+
+
+def step(world):
+    ammo = world.comps["ammo"]
+    half = ammo / 2
+    decay = world.comps["ammo"] - 0.5
+    return half, decay

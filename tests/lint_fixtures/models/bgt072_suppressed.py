@@ -1,0 +1,10 @@
+"""BGT072 with a justified line suppression."""
+import jax.numpy as jnp
+
+
+def register(app):
+    app.rollback_component("charge", (1,), jnp.int32)
+
+
+def hud_scale(world):
+    return world.comps["charge"] * 0.25  # bgt: ignore[BGT072]: display-only rescale on a host copy, never written back to the world

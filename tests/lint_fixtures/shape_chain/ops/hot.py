@@ -1,0 +1,6 @@
+"""Sim-scope driver: no shape-hazard syntax anywhere in this file."""
+from ..digest import fold_parts
+
+
+def tick(world):
+    return fold_parts(world)

@@ -1,0 +1,6 @@
+"""Non-sim helper with the hazard sanctioned at its seed line."""
+import jax.numpy as jnp
+
+
+def fold_parts(parts):
+    return jnp.stack(parts)  # bgt: ignore[BGT071]: part count is fixed by the registry, never data-dependent

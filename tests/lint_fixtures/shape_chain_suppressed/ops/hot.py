@@ -1,0 +1,7 @@
+"""Sim-scope driver: identical to shape_chain/ops/hot.py — the seed
+sanction in digest.py must clear the chain finding here."""
+from ..digest import fold_parts
+
+
+def tick(world):
+    return fold_parts(world)

@@ -1,0 +1,19 @@
+"""Twin fixtures, batched half: ``drain`` matches the solo half after
+normalization (different local names + telemetry label), ``tally`` has
+genuinely drifted, ``ping`` is identical (a declared drift that
+converged)."""
+
+
+class Batched:
+    def drain(self, queue):
+        drained = []
+        while queue:
+            drained.append(queue.pop())
+        self._t.count("batched_drain_total")
+        return drained
+
+    def tally(self, xs):
+        return sum(xs)
+
+    def ping(self):
+        return self._clock.now()

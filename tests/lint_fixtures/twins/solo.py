@@ -1,0 +1,19 @@
+"""Twin fixtures, solo half (see test_lint.py's BGT073 tests)."""
+
+
+class Solo:
+    def drain(self, q):
+        out = []
+        while q:
+            out.append(q.pop())
+        self._t.count("drain_total")
+        return out
+
+    def tally(self, xs):
+        total = 0
+        for x in xs:
+            total += x
+        return total
+
+    def ping(self):
+        return self._clock.now()

@@ -1,0 +1,142 @@
+"""BGT_COMPILE_GUARD steady-state recompile sentinel: armed compiles
+raise :class:`RecompileError` naming owner and kind and count into
+``recompiles_steady_total``; disabled/disarmed guards are no-ops; and the
+e2e half — the exact per-call-varying-static-arg toy runner that BGT070
+flags statically (tests/lint_fixtures/bgt070_e2e.py) — trips the armed
+``watch_jax`` guard at runtime on the SAME site.
+
+The guard mirrors the ``BGT_SANITIZE`` transfer sanitizer's shape:
+env-enabled, starts disarmed so warmup compiles pass, one attribute
+check per compile event when off.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from bevy_ggrs_tpu import telemetry  # noqa: E402
+from bevy_ggrs_tpu.utils import compile_guard  # noqa: E402
+from bevy_ggrs_tpu.utils.compile_guard import (  # noqa: E402
+    CompileGuard,
+    RecompileError,
+    set_compile_guard,
+)
+
+E2E_FIXTURE = ROOT / "tests" / "lint_fixtures" / "bgt070_e2e.py"
+
+
+@pytest.fixture(autouse=True)
+def _guard_off_after():
+    yield
+    set_compile_guard(False)
+    telemetry.disable()
+    telemetry.reset()
+
+
+def test_env_var_enables_the_guard(monkeypatch):
+    monkeypatch.setenv("BGT_COMPILE_GUARD", "1")
+    assert CompileGuard().enabled
+    monkeypatch.delenv("BGT_COMPILE_GUARD")
+    assert not CompileGuard().enabled
+
+
+def test_disabled_guard_never_arms_and_notify_is_a_noop():
+    g = set_compile_guard(False)
+    assert g.arm() is False and not g.armed
+    compile_guard.notify("solo", "plain:d4", 12.0)  # must not raise
+    assert g.steady_compiles == []
+
+
+def test_enabled_but_disarmed_guard_passes_warmup_compiles():
+    set_compile_guard(True)
+    compile_guard.notify("batched", "exact:k8", 40.0)  # warmup: no raise
+    assert compile_guard.guard().steady_compiles == []
+
+
+def test_armed_guard_trips_with_owner_kind_and_counter():
+    telemetry.enable()
+    g = set_compile_guard(True)
+    assert g.arm() is True
+    with pytest.raises(RecompileError) as ei:
+        compile_guard.notify("batched", "padded:k8", 12.5)
+    assert ei.value.owner == "batched" and ei.value.kind == "padded:k8"
+    assert "BGT070" in str(ei.value) and "BGT071" in str(ei.value)
+    assert g.steady_compiles == [("batched", "padded:k8", 12.5)]
+    c = telemetry.registry().counter("recompiles_steady_total", "")
+    assert c.value(owner="batched") == 1
+
+
+def test_disarm_returns_to_warmup_behavior():
+    g = set_compile_guard(True)
+    g.arm()
+    g.disarm()
+    compile_guard.notify("solo", "branched:d2", 1.0)
+    assert g.steady_compiles == []
+
+
+def test_runner_arm_methods_delegate_to_the_guard():
+    """Both runners expose arm_compile_guard(); it returns False when the
+    guard is disabled (engine code may call it unconditionally) and True
+    once enabled.  The methods touch no runner state, so a bare instance
+    is enough — no session construction needed."""
+    from bevy_ggrs_tpu.batch_runner import BatchedRunner
+    from bevy_ggrs_tpu.runner import GgrsRunner
+
+    set_compile_guard(False)
+    for cls in (GgrsRunner, BatchedRunner):
+        inst = object.__new__(cls)
+        assert inst.arm_compile_guard() is False
+    set_compile_guard(True)
+    for cls in (GgrsRunner, BatchedRunner):
+        inst = object.__new__(cls)
+        assert inst.arm_compile_guard() is True
+        compile_guard.guard().disarm()
+
+
+# -- e2e: the BGT070 site trips both halves -----------------------------------
+
+
+def _load_toy():
+    spec = importlib.util.spec_from_file_location("bgt070_e2e", E2E_FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_e2e_lint_flags_the_toy_runner_site():
+    from scripts.lint import run as lint_run
+    from scripts.lint.config import Config
+
+    findings, _files = lint_run(
+        [str(E2E_FIXTURE)], root=ROOT, config=Config(project_checks=False))
+    hits = [f for f in findings if f.rule == "BGT070"]
+    assert len(hits) == 1, [f.as_dict() for f in findings]
+    assert "static_argnums" in hits[0].message
+    jit_line = next(
+        i for i, ln in enumerate(E2E_FIXTURE.read_text().splitlines(), 1)
+        if "jax.jit" in ln)
+    assert hits[0].line == jit_line
+
+
+def test_e2e_armed_watch_jax_guard_trips_on_the_same_site():
+    """Runtime half: warmup tick compiles freely; after arming with
+    watch_jax, the next tick's fresh-wrapper compile (the per-call-varying
+    static arg BGT070 flagged) raises RecompileError attributed to jax."""
+    import jax.numpy as jnp
+
+    toy = _load_toy()
+    x = jnp.arange(4.0)
+    toy.tick(x, 2.0)  # warmup: guard disarmed, compile passes
+
+    g = set_compile_guard(True)
+    assert g.arm(watch_jax=True) is True
+    with pytest.raises(RecompileError) as ei:
+        toy.tick(x, 3.0)
+    assert ei.value.owner == "jax"
+    assert g.steady_compiles and g.steady_compiles[0][0] == "jax"

@@ -76,6 +76,9 @@ TRIPLES = [
     ("BGT043", "models/bgt043", 3),
     ("BGT044", "models/bgt044", 3),
     ("BGT005", "bgt005", 1),
+    ("BGT070", "bgt070", 4),
+    ("BGT071", "models/bgt071", 5),
+    ("BGT072", "models/bgt072", 2),
 ]
 
 
@@ -595,7 +598,7 @@ def test_changed_slice_agrees_with_full_run():
     corpus structurally cannot support."""
     from scripts.lint.incremental import expand_dependents
 
-    PARTIAL_SKIPPED = {"BGT005", "BGT022", "BGT031", "BGT033"}
+    PARTIAL_SKIPPED = {"BGT005", "BGT022", "BGT031", "BGT033", "BGT073"}
     slice_paths = expand_dependents(
         {"bevy_ggrs_tpu/fleet/protocol.py"}, ROOT)
     assert slice_paths
@@ -697,3 +700,212 @@ def test_shim_cli_still_works():
     )
     assert res.returncode == 0, res.stdout + res.stderr
     assert "lint:" in res.stdout
+
+
+# -- recompilation & engine drift (BGT07x) ------------------------------------
+
+
+def _shape_chain_paths(pkg):
+    d = FIXTURES / pkg
+    return [d / "__init__.py", d / "digest.py",
+            d / "ops" / "__init__.py", d / "ops" / "hot.py"]
+
+
+def test_bgt071_chain_flagged_at_sim_call_site():
+    """The interprocedural acceptance shape: ops/hot.py has no hazard
+    syntax, the jnp.stack over a dynamic sequence lives in non-sim
+    digest.py — the chain finding lands at the sim-scope call site with
+    the full witness path down to the seed."""
+    findings = lint_paths(_shape_chain_paths("shape_chain"),
+                          package_dir="tests/lint_fixtures/shape_chain")
+    hits = only(findings, "BGT071")
+    assert len(hits) == 1, [f.as_dict() for f in findings]
+    f = hits[0]
+    assert f.path.endswith("shape_chain/ops/hot.py") and not f.suppressed
+    for fragment in ("tick", "fold_parts", "digest.py", "stack"):
+        assert fragment in f.message, f.message
+
+
+def test_bgt071_seed_sanction_clears_every_caller():
+    findings = lint_paths(
+        _shape_chain_paths("shape_chain_suppressed"),
+        package_dir="tests/lint_fixtures/shape_chain_suppressed")
+    assert only(findings, "BGT071") == [], \
+        "suppressing at the seed (hazard) line must clear the whole chain"
+
+
+# BGT073 twin pairs share the two fixture halves; each test declares its
+# own map (the config is the rule's input surface)
+_TWINS = "tests/lint_fixtures/twins"
+_SOLO = f"{_TWINS}/solo.py::Solo"
+_BATCH = f"{_TWINS}/batched.py::Batched"
+
+
+def _twin_findings(twin_map):
+    d = FIXTURES / "twins"
+    return only(lint_paths([d / "solo.py", d / "batched.py"],
+                           twin_map=twin_map, twins_json=None), "BGT073")
+
+
+def test_bgt073_sync_pair_in_sync_is_clean():
+    # different local names AND a different telemetry label string: both
+    # must normalize away
+    assert _twin_findings(
+        ((f"{_SOLO}.drain", f"{_BATCH}.drain", "sync", "queue drain"),)
+    ) == []
+
+
+def test_bgt073_declared_sync_pair_drifted_fires():
+    hits = _twin_findings(
+        ((f"{_SOLO}.tally", f"{_BATCH}.tally", "sync", "input tally"),))
+    assert len(hits) == 1 and hits[0].path.endswith("twins/solo.py")
+    assert "declared-sync twin drifted" in hits[0].message
+    assert "similarity" in hits[0].message
+
+
+def test_bgt073_declared_drift_pair_converged_fires():
+    hits = _twin_findings(
+        ((f"{_SOLO}.ping", f"{_BATCH}.ping", "drift", "clock probe"),))
+    assert len(hits) == 1
+    assert "declared-drift twin converged" in hits[0].message
+
+
+def test_bgt073_map_rot_fires():
+    hits = _twin_findings(
+        ((f"{_SOLO}.gone", f"{_BATCH}.drain", "sync", "rotted ref"),))
+    assert len(hits) == 1
+    assert "twin map rot" in hits[0].message and "gone" in hits[0].message
+
+
+def test_bgt073_partial_corpus_is_silent():
+    d = FIXTURES / "twins"
+    findings = lint_paths(
+        [d / "solo.py", d / "batched.py"],
+        twin_map=((f"{_SOLO}.tally", f"{_BATCH}.tally", "sync", "t"),),
+        twins_json=None, partial_corpus=True)
+    assert only(findings, "BGT073") == []
+
+
+def test_twins_json_inventory_written(tmp_path):
+    """Full-project-run shape: project_checks on + twins_json set writes
+    the ROADMAP-5 work-list with per-pair status and similarity."""
+    import shutil
+
+    d = tmp_path / "twins"
+    d.mkdir()
+    for name in ("solo.py", "batched.py"):
+        shutil.copy(FIXTURES / "twins" / name, d / name)
+    cfg = Config(
+        project_checks=True, twins_json="out_twins.json",
+        metric_docs="docs/observability.md",
+        rule_docs="docs/static-analysis.md",
+        twin_map=(
+            ("twins/solo.py::Solo.drain", "twins/batched.py::Batched.drain",
+             "sync", "queue drain"),
+            ("twins/solo.py::Solo.tally", "twins/batched.py::Batched.tally",
+             "drift", "input tally"),
+        ),
+    )
+    run([str(d / "solo.py"), str(d / "batched.py")],
+        root=tmp_path, config=cfg)
+    payload = json.loads((tmp_path / "out_twins.json").read_text())
+    assert payload["version"] == 1 and payload["drifted"] == 1
+    by_solo = {p["solo"]: p for p in payload["pairs"]}
+    assert by_solo["twins/solo.py::Solo.drain"]["status"] == "in_sync"
+    assert by_solo["twins/solo.py::Solo.drain"]["similarity"] == 1.0
+    tally = by_solo["twins/solo.py::Solo.tally"]
+    assert tally["status"] == "drifted" and 0 < tally["similarity"] < 1
+    assert tally["solo_lines"] >= 1 and tally["batched_lines"] >= 1
+
+
+def test_repo_twin_map_references_resolve_and_inventory_is_emitted():
+    """The REAL twin map: every declared pair resolves at HEAD (no rot)
+    and the repo-root LINT_twins.json inventory carries >= 5 pairs."""
+    findings, _files = run(None, root=ROOT, config=Config())
+    assert only([f for f in findings if not f.suppressed], "BGT073") == []
+    payload = json.loads((ROOT / "LINT_twins.json").read_text())
+    assert len(payload["pairs"]) >= 5
+    assert all(p["status"] in ("in_sync", "drifted") for p in payload["pairs"])
+
+
+# -- content-hash result cache (--cache) --------------------------------------
+
+
+def _norm_findings(findings):
+    return sorted(
+        (f.rule, f.path, f.line, f.message, f.suppressed) for f in findings
+    )
+
+
+def test_cache_cold_and_warm_agree_exactly_with_full_run(tmp_path):
+    """Unlike --changed (which drops whole-repo reverse checks), --cache
+    must reproduce the full run's findings EXACTLY — whole-corpus rules
+    run fresh and per-file results replay from the manifest."""
+    from scripts.lint.cache import cached_run
+
+    cache = tmp_path / "cache.json"
+    cold, _, stats = cached_run(ROOT, cache_path=cache)
+    assert stats["mode"] == "rebuild" and stats["reused"] == 0
+    warm, _, stats = cached_run(ROOT, cache_path=cache)
+    assert stats["mode"] == "warm" and stats["analyzed"] == 0
+    assert stats["reused"] > 0
+    plain, _ = run(None, root=ROOT, config=Config())
+    assert _norm_findings(warm) == _norm_findings(plain)
+    assert _norm_findings(cold) == _norm_findings(plain)
+
+
+def _mini_repo(tmp_path):
+    pkg = tmp_path / "bevy_ggrs_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "util.py").write_text("def helper():\n    return 1\n")
+    (pkg / "main.py").write_text(
+        "import os\n\nfrom bevy_ggrs_tpu.util import helper\n\n\n"
+        "def tick():\n    return helper()\n")
+    return pkg
+
+
+def test_cache_slices_on_mutation_and_still_agrees(tmp_path):
+    """Mutating one file re-analyzes its bidirectional import closure
+    (the file plus its importer) and the merged result matches a fresh
+    full run; adding a file falls back to a rebuild."""
+    from scripts.lint.cache import cached_run
+
+    pkg = _mini_repo(tmp_path)
+    cfg = Config(project_checks=False)
+    cache = tmp_path / "cache.json"
+    _f, _x, stats = cached_run(tmp_path, config=cfg, cache_path=cache)
+    assert stats["mode"] == "rebuild"
+
+    (pkg / "util.py").write_text("import sys\n\n\ndef helper():\n    return 1\n")
+    warm, _x, stats = cached_run(tmp_path, config=cfg, cache_path=cache)
+    assert stats["mode"] == "warm"
+    assert stats["analyzed"] >= 2, "importer main.py must re-enter the slice"
+    plain, _x = run(None, root=tmp_path, config=cfg)
+    assert _norm_findings(warm) == _norm_findings(plain)
+    assert any(f.rule == "BGT001" and f.path.endswith("util.py")
+               for f in warm), "fresh finding on the mutated file"
+
+    (pkg / "extra.py").write_text("X = 1\n")
+    _f, _x, stats = cached_run(tmp_path, config=cfg, cache_path=cache)
+    assert stats["mode"] == "rebuild", "a changed file SET rebuilds"
+
+
+def test_cache_cli_timings_and_soft_time_budget(tmp_path):
+    """--cache --timings prints the per-family wall-time table; an
+    exceeded --time-budget warns but stays a soft gate (exit 0), and
+    --time-budget-hard turns it into a failure."""
+    res = subprocess.run(
+        [sys.executable, "-m", "scripts.lint", "--cache", "--timings",
+         "--time-budget", "0.001"],
+        cwd=ROOT, capture_output=True, text=True, timeout=180,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "lint: cache" in res.stdout
+    assert "lint-timing: total" in res.stdout
+    assert "WARNING" in res.stdout and "soft" in res.stdout
+
+    from scripts.lint.core import main as lint_main
+    rc = lint_main(["--cache", "--time-budget", "0.001",
+                    "--time-budget-hard"])
+    assert rc == 1
